@@ -1,0 +1,44 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM (reference: /root/reference,
+v3.0.0.99) for TPU: the data plane is packed integer bin arrays in HBM,
+histogram construction / split finding / partitioning run under JAX/XLA
+(Pallas kernels for the hot ops), and distributed training maps onto
+ICI/DCN collectives over a `jax.sharding.Mesh` instead of the reference's
+socket/MPI network layer.
+
+Public API mirrors the reference python-package: `Dataset`, `Booster`,
+`train`, `cv`, and sklearn-style wrappers.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+from .utils import log
+from .utils.log import LightGBMError
+
+__all__ = [
+    "Config",
+    "LightGBMError",
+    "__version__",
+]
+
+
+def _register_api():
+    """Late-bound re-exports (populated as modules land)."""
+    global __all__
+    try:
+        from .basic import Booster, Dataset  # noqa: F401
+        from .engine import CVBooster, cv, train  # noqa: F401
+        __all__ += ["Dataset", "Booster", "train", "cv", "CVBooster"]
+    except ImportError:
+        pass
+    try:
+        from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
+                              LGBMRanker, LGBMRegressor)
+        __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+    except ImportError:
+        pass
+
+
+_register_api()

@@ -1,0 +1,60 @@
+"""Logging for lightgbm_tpu.
+
+TPU-native equivalent of the reference's ``Log`` utility
+(reference: include/LightGBM/utils/log.h:81-110): leveled logging with a
+registerable callback (used by the Python-facing API the same way the
+reference routes C++ logs through a ctypes callback, python-package
+lightgbm/basic.py:24).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+# config-level verbosity, reference scale (src/io/config.cpp:234-242):
+# <0: fatal only, 0: warning+error, 1: info (default), >=2: debug
+_verbosity = 1
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(Exception):
+    """Error raised by lightgbm_tpu (mirrors the reference's LightGBMError)."""
+
+
+def set_verbosity(level: int) -> None:
+    """<0: fatal only, 0: warning, 1: info, >=2: debug (reference scale)."""
+    global _verbosity
+    _verbosity = level
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def _emit(msg: str) -> None:
+    if _callback is not None:
+        _callback(msg + "\n")
+    else:
+        sys.stderr.write(msg + "\n")
+
+
+def debug(msg: str, *args) -> None:
+    if _verbosity >= 2:
+        _emit("[LightGBM-TPU] [Debug] " + (msg % args if args else msg))
+
+
+def info(msg: str, *args) -> None:
+    if _verbosity >= 1:
+        _emit("[LightGBM-TPU] [Info] " + (msg % args if args else msg))
+
+
+def warning(msg: str, *args) -> None:
+    if _verbosity >= 0:
+        _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
+
+
+def fatal(msg: str, *args) -> None:
+    text = msg % args if args else msg
+    _emit("[LightGBM-TPU] [Fatal] " + text)
+    raise LightGBMError(text)

@@ -1,0 +1,152 @@
+"""BinMapper behavioral tests.
+
+Oracle: semantics of reference src/io/bin.cpp (GreedyFindBin /
+FindBinWithZeroAsOneBin / FindBin / ValueToBin) — equal-count bins, zero bin
+reservation, NaN bin reservation, categorical count-ordered mapping.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                     MISSING_NONE, MISSING_ZERO, BinMapper,
+                                     greedy_find_bin)
+
+
+def test_greedy_few_distinct():
+    # fewer distinct values than max_bin: boundaries at midpoints
+    dv = np.array([1.0, 2.0, 3.0])
+    cnt = np.array([5, 5, 5])
+    bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=15, min_data_in_bin=1)
+    assert len(bounds) == 3
+    assert bounds[-1] == math.inf
+    assert 1.0 < bounds[0] <= np.nextafter(1.5, np.inf)
+    assert 2.0 < bounds[1] <= np.nextafter(2.5, np.inf)
+
+
+def test_greedy_min_data_in_bin():
+    dv = np.array([1.0, 2.0, 3.0, 4.0])
+    cnt = np.array([1, 1, 1, 100])
+    bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=103, min_data_in_bin=3)
+    # first boundary only after accumulating >= 3 data
+    assert len(bounds) == 2  # one split: {1,2,3} | {4}
+
+
+def test_greedy_equal_count():
+    # many distinct values: bins roughly equal count
+    rng = np.random.RandomState(0)
+    vals = np.sort(rng.uniform(0, 1, 1000))
+    dv, cnt = np.unique(vals, return_counts=True)
+    bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=1000, min_data_in_bin=1)
+    assert len(bounds) <= 10
+    assert bounds[-1] == math.inf
+    # roughly equal-count bins
+    binned = np.searchsorted(bounds, vals, side="left")
+    counts = np.bincount(binned, minlength=len(bounds))
+    assert counts.max() < 1000 / len(bounds) * 2.5
+
+
+def test_find_bin_zero_bin_reserved():
+    m = BinMapper()
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([rng.uniform(-5, -1, 300), rng.uniform(1, 5, 500)])
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=16)  # 200 implicit zeros
+    assert m.missing_type == MISSING_NONE
+    zero_bin = m.value_to_bin(0.0)
+    assert m.value_to_bin(1e-40) == zero_bin
+    assert m.value_to_bin(-1e-40) == zero_bin
+    assert m.value_to_bin(-1.5) < zero_bin
+    assert m.value_to_bin(1.5) > zero_bin
+    assert m.default_bin == zero_bin
+
+
+def test_find_bin_nan_missing():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, np.nan, np.nan])
+    m.find_bin(vals, total_sample_cnt=7, max_bin=10, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    # all regular values below the NaN bin
+    for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        assert m.value_to_bin(v) < m.num_bin - 1
+
+
+def test_find_bin_no_missing_nan_as_zero():
+    m = BinMapper()
+    vals = np.array([-1.0, 1.0, 2.0, 3.0])
+    m.find_bin(vals, total_sample_cnt=8, max_bin=10, min_data_in_bin=1,
+               use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    assert m.value_to_bin(np.nan) == m.value_to_bin(0.0)
+
+
+def test_find_bin_zero_as_missing():
+    m = BinMapper()
+    vals = np.concatenate([np.linspace(1, 10, 50), np.linspace(-10, -1, 50)])
+    m.find_bin(vals, total_sample_cnt=200, max_bin=20, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_value_to_bin_monotonic():
+    m = BinMapper()
+    rng = np.random.RandomState(3)
+    vals = rng.normal(0, 10, 5000)
+    m.find_bin(vals, total_sample_cnt=5000, max_bin=255)
+    xs = np.linspace(-30, 30, 1000)
+    bins = m.values_to_bins(xs)
+    assert (np.diff(bins) >= 0).all()
+    assert bins.max() < m.num_bin
+    # boundary consistency: value <= upper_bound[bin]
+    for x, b in zip(xs[::50], bins[::50]):
+        assert x <= m.bin_upper_bound[b]
+        if b > 0:
+            assert x > m.bin_upper_bound[b - 1]
+
+
+def test_vectorized_matches_scalar():
+    m = BinMapper()
+    rng = np.random.RandomState(4)
+    vals = np.concatenate([rng.normal(0, 1, 1000), [np.nan] * 10])
+    m.find_bin(vals, total_sample_cnt=1200, max_bin=63)
+    test_vals = np.concatenate([rng.normal(0, 2, 200), [np.nan, 0.0, 1e300, -1e300]])
+    vec = m.values_to_bins(test_vals)
+    for v, b in zip(test_vals, vec):
+        assert m.value_to_bin(v) == b
+
+
+def test_categorical_mapping():
+    m = BinMapper()
+    # category 7 most frequent, then 3, then 1
+    vals = np.array([7.0] * 50 + [3.0] * 30 + [1.0] * 20)
+    m.find_bin(vals, total_sample_cnt=100, max_bin=10, min_data_in_bin=1,
+               bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    # bin 0 reserved for NaN/unseen; most frequent category gets bin 1
+    assert m.value_to_bin(7) == 1
+    assert m.value_to_bin(3) == 2
+    assert m.value_to_bin(1) == 3
+    assert m.value_to_bin(999) == 0  # unseen
+    assert m.value_to_bin(np.nan) == 0
+    assert m.bin_2_categorical[1] == 7
+
+
+def test_trivial_feature():
+    m = BinMapper()
+    m.find_bin(np.array([5.0] * 100), total_sample_cnt=100, max_bin=255)
+    assert not m.is_trivial  # two bins: zero bin + 5.0 bin (implicit zeros=0)
+    m2 = BinMapper()
+    m2.find_bin(np.array([], dtype=np.float64), total_sample_cnt=100, max_bin=255)
+    assert m2.is_trivial  # all zeros -> single bin
+
+
+def test_serialization_roundtrip():
+    m = BinMapper()
+    rng = np.random.RandomState(5)
+    vals = np.concatenate([rng.normal(0, 1, 500), [np.nan] * 5])
+    m.find_bin(vals, total_sample_cnt=600, max_bin=31)
+    m2 = BinMapper.from_dict(m.to_dict())
+    xs = rng.normal(0, 2, 100)
+    np.testing.assert_array_equal(m.values_to_bins(xs), m2.values_to_bins(xs))
+    assert m2.missing_type == m.missing_type
+    assert m2.num_bin == m.num_bin

@@ -1,0 +1,95 @@
+"""BinnedDataset construction tests (oracle: reference Dataset semantics,
+src/io/dataset.cpp / dataset_loader.cpp)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _make(rng, n=1000, f=10):
+    X = rng.normal(0, 1, (n, f))
+    y = (X[:, 0] + rng.normal(0, 0.1, n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_construct_basic(rng):
+    X, y = _make(rng)
+    ds = BinnedDataset.from_matrix(X, Config.from_params({"max_bin": 63}), label=y)
+    assert ds.num_data == 1000
+    assert ds.num_features == 10
+    assert ds.bins.shape == (1000, 10)
+    assert ds.bins.dtype == np.uint8
+    assert (ds.num_bins_per_feature <= 63).all()
+    assert ds.metadata.label is not None
+
+
+def test_trivial_features_dropped(rng):
+    X, y = _make(rng, f=5)
+    X = np.concatenate([X, np.zeros((1000, 2))], axis=1)  # two constant cols
+    ds = BinnedDataset.from_matrix(X, Config(), label=y)
+    assert ds.num_features == 5
+    assert ds.real_feature_index == [0, 1, 2, 3, 4]
+    assert ds.num_total_features == 7
+
+
+def test_bins_consistent_with_mappers(rng):
+    X, y = _make(rng, n=500, f=4)
+    ds = BinnedDataset.from_matrix(X, Config.from_params({"max_bin": 31}), label=y)
+    for i in range(4):
+        expected = ds.bin_mappers[i].values_to_bins(X[:, i])
+        np.testing.assert_array_equal(ds.bins[:, i], expected.astype(ds.bins.dtype))
+
+
+def test_valid_aligned_with_reference(rng):
+    X, y = _make(rng)
+    Xv, yv = _make(rng, n=200)
+    ds = BinnedDataset.from_matrix(X, Config(), label=y)
+    dv = ds.create_valid(Xv, label=yv)
+    assert dv.bin_mappers is ds.bin_mappers
+    assert dv.num_data == 200
+    np.testing.assert_array_equal(
+        dv.bins[:, 0], ds.bin_mappers[0].values_to_bins(Xv[:, 0]).astype(dv.bins.dtype))
+
+
+def test_group_boundaries(rng):
+    X, y = _make(rng, n=100)
+    ds = BinnedDataset.from_matrix(X, Config(), label=y, group=np.array([30, 50, 20]))
+    np.testing.assert_array_equal(ds.metadata.query_boundaries, [0, 30, 80, 100])
+    assert ds.metadata.num_queries == 3
+
+
+def test_binary_roundtrip(tmp_path, rng):
+    X, y = _make(rng, n=300, f=6)
+    w = rng.uniform(0.5, 2.0, 300).astype(np.float32)
+    ds = BinnedDataset.from_matrix(X, Config(), label=y, weight=w)
+    path = str(tmp_path / "ds.bin")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+    np.testing.assert_array_equal(ds.metadata.weights, ds2.metadata.weights)
+    assert ds2.real_feature_index == ds.real_feature_index
+    xs = rng.normal(0, 1, 50)
+    np.testing.assert_array_equal(ds.bin_mappers[0].values_to_bins(xs),
+                                  ds2.bin_mappers[0].values_to_bins(xs))
+
+
+def test_max_bin_by_feature(rng):
+    X, y = _make(rng, f=3)
+    cfg = Config.from_params({"max_bin_by_feature": [5, 10, 200]})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    nb = ds.num_bins_per_feature
+    assert nb[0] <= 5 and nb[1] <= 10
+
+
+def test_config_aliases():
+    cfg = Config.from_params({"n_estimators": 50, "eta": "0.3",
+                              "colsample_bytree": 0.5, "min_child_samples": 7,
+                              "objective": "l2", "metric": "mse"})
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.3
+    assert cfg.feature_fraction == 0.5
+    assert cfg.min_data_in_leaf == 7
+    assert cfg.objective == "regression"
+    assert cfg.metric == ["l2"]
