@@ -1,0 +1,182 @@
+"""Histogram construction kernels.
+
+The gradient/hessian histogram is THE hot loop of gradient boosting
+(reference: per-feature scatter loops in src/io/dense_bin.hpp:98
+``ConstructHistogramInner`` and the row-wise
+src/io/multi_val_dense_bin.hpp:54 path, plus the OpenCL local-memory
+atomics kernels src/treelearner/ocl/histogram{16,64,256}.cl).
+
+TPU re-design: there are no fast global atomics on TPU, so instead of
+scatter-adds we accumulate *privatized* histograms in VMEM, exactly the
+shape of the reference GPU kernel's local-memory strategy but mapped to
+the TPU memory hierarchy:
+
+- ``histogram_pallas``: a Pallas kernel; the grid walks row blocks, each
+  block loads ``[rows_per_block, F]`` bin codes into VMEM and runs a
+  bin-indexed masked multiply-accumulate on the VPU, accumulating into a
+  ``[2, B, F]`` VMEM-resident output that only flushes to HBM once.
+  HBM traffic is therefore one read of the bin codes + grad/hess.
+- ``histogram_scatter``: jnp scatter-add formulation — the portable
+  reference oracle (mirrors the role of GPU_DEBUG_COMPARE in
+  reference gpu_tree_learner.cpp:992-1030) and the CPU-backend path.
+
+Histograms hold (sum_gradient, sum_hessian) per (feature, bin); bin
+counts are NOT stored — like the reference (bin.h:41-42 GET_GRAD/GET_HESS,
+hist entries are pairs), counts are recovered as
+``round(hess * num_data / sum_hess)`` at split-scan time
+(feature_histogram.hpp cnt_factor).
+
+Output layout: ``[F, B, 2]`` float32, channel 0 = grad, 1 = hess.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def histogram_scatter(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                      num_bins: int) -> jax.Array:
+    """Scatter-add histogram: oracle + CPU path.
+
+    bins: [C, F] integer bin codes; grad/hess: [C] float32 (zeros for
+    padding rows). Returns [F, B, 2] float32.
+    """
+    c, f = bins.shape
+    b = bins.astype(jnp.int32)
+    hist = jnp.zeros((f, num_bins, 2), dtype=jnp.float32)
+    feat_idx = jnp.broadcast_to(jnp.arange(f, dtype=jnp.int32)[None, :], (c, f))
+    vals = jnp.stack([grad, hess], axis=-1).astype(jnp.float32)  # [C, 2]
+    vals = jnp.broadcast_to(vals[:, None, :], (c, f, 2))
+    return hist.at[feat_idx.reshape(-1), b.reshape(-1)].add(
+        vals.reshape(-1, 2), mode="drop")
+
+
+def _hist_pallas_kernel(bins_ref, grad_ref, hess_ref, out_ref, *, num_bins: int):
+    """Pallas TPU kernel body: one row block → accumulate [2, B, F].
+
+    Grid iterations run sequentially per TPU core, so ``out_ref`` can be
+    initialized on the first step and accumulated across steps (the same
+    sub-histogram reduction the reference GPU kernel does with
+    sync_counters_, here for free from the sequential grid).
+    """
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[...]            # [Rb, F] int32
+    g = grad_ref[...]               # [Rb, 1] f32
+    h = hess_ref[...]               # [Rb, 1] f32
+
+    def body(b, _):
+        mask = (bins == b).astype(jnp.float32)          # [Rb, F]
+        gsum = jnp.sum(mask * g, axis=0)                # [F]
+        hsum = jnp.sum(mask * h, axis=0)                # [F]
+        idx = (slice(None), pl.dslice(b, 1), slice(None))
+        out_ref[idx] = out_ref[idx] + jnp.stack([gsum, hsum])[:, None, :]
+        return ()
+
+    jax.lax.fori_loop(0, num_bins, body, ())
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "rows_per_block", "interpret"))
+def histogram_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                     num_bins: int, rows_per_block: int = 1024,
+                     interpret: bool = False) -> jax.Array:
+    """Pallas TPU histogram. Same contract as histogram_scatter."""
+    from jax.experimental import pallas as pl
+
+    c, f = bins.shape
+    nblk = max(1, (c + rows_per_block - 1) // rows_per_block)
+    pad = nblk * rows_per_block - c
+    b32 = bins.astype(jnp.int32)
+    if pad:
+        # padding rows carry bin -1 (matches no bin) and zero grad/hess
+        b32 = jnp.pad(b32, ((0, pad), (0, 0)), constant_values=-1)
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+
+    out = pl.pallas_call(
+        functools.partial(_hist_pallas_kernel, num_bins=num_bins),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((rows_per_block, f), lambda i: (i, 0)),
+            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, num_bins, f), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, num_bins, f), jnp.float32),
+        interpret=interpret,
+    )(b32, grad.astype(jnp.float32)[:, None], hess.astype(jnp.float32)[:, None])
+    return jnp.transpose(out, (2, 1, 0))  # → [F, B, 2]
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+              num_bins: int, method: Optional[str] = None) -> jax.Array:
+    """Backend-dispatched histogram [F, B, 2]."""
+    if method is None:
+        method = "pallas" if _use_pallas() else "scatter"
+    if method == "pallas":
+        return histogram_pallas(bins, grad, hess, num_bins)
+    return histogram_scatter(bins, grad, hess, num_bins)
+
+
+# ---------------------------------------------------------------------------
+# Leaf-gather helpers (capacity-padded; reference analogue: the
+# ordered_gradients_/ordered_hessians_ gather in serial_tree_learner.cpp
+# and DataPartition's contiguous per-leaf index ranges).
+# ---------------------------------------------------------------------------
+
+def leaf_window(perm: jax.Array, start, count, capacity: int):
+    """Capacity-padded window of the permutation array covering a leaf.
+
+    ``start``/``count`` are traced scalars; ``capacity`` is static
+    (count rounded up to a power of two by the caller so jit
+    specializations are bounded and reusable). When the window would run
+    past the end of ``perm`` the read start is clamped left, so the
+    leaf's rows sit at offset ``start - read_start`` inside the window —
+    ``valid`` marks exactly the leaf's rows.
+
+    Returns (rows_raw, valid, read_start): raw window contents (NOT
+    clamped — positions outside ``valid`` hold other leaves' rows or
+    zero padding), the in-leaf mask, and where the window was read from.
+    """
+    start = jnp.asarray(start, jnp.int32)
+    n = perm.shape[0]
+    read_start = jnp.minimum(start, max(n - capacity, 0))
+    rows = jax.lax.dynamic_slice(perm, (read_start,), (min(capacity, n),))
+    if capacity > n:
+        rows = jnp.pad(rows, (0, capacity - n))
+    off = start - read_start
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    valid = (pos >= off) & (pos < off + count)
+    return rows, valid, read_start
+
+
+def gather_leaf_rows(perm: jax.Array, start, count, capacity: int):
+    """Leaf row ids padded to ``capacity``; non-leaf positions clamped to
+    row 0 and flagged invalid (for masked gathers)."""
+    rows, valid, _ = leaf_window(perm, start, count, capacity)
+    return jnp.where(valid, rows, 0), valid
+
+
+def leaf_histogram(bins_full: jax.Array, perm: jax.Array, start, count,
+                   grad: jax.Array, hess: jax.Array, capacity: int,
+                   num_bins: int, method: Optional[str] = None) -> jax.Array:
+    """Histogram of one leaf's rows (the reference's ConstructHistograms
+    for the smaller leaf, serial_tree_learner.cpp:333): gather bin rows +
+    ordered grad/hess by the leaf's index range, then histogram."""
+    rows, valid = gather_leaf_rows(perm, start, count, capacity)
+    b = bins_full[rows]
+    g = jnp.where(valid, grad[rows], 0.0)
+    h = jnp.where(valid, hess[rows], 0.0)
+    return histogram(b, g, h, num_bins, method=method)

@@ -1,0 +1,84 @@
+"""Leaf data partitioning as permutation-array updates.
+
+TPU re-design of the reference DataPartition
+(reference: src/treelearner/data_partition.hpp — one flat ``indices_``
+permutation array with per-leaf [begin, count) ranges; ``Split`` at :101
+runs a threaded stable two-way partition via ParallelPartitionRunner,
+include/LightGBM/utils/threading.h:80).
+
+Here the permutation lives on device; splitting a leaf is a stable
+argsort of a 3-way key (left / right / padding) over a capacity-padded
+window of the permutation, written back with dynamic_update_slice.
+``capacity`` is static (power-of-two bucketing by the caller) so the jit
+cache stays small; ``start``/``count`` and the split description are
+traced, so one compiled kernel serves every leaf of that size class.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import leaf_window
+
+
+def _decision_go_left(binval, threshold, default_left, miss_bin, is_cat,
+                      cat_bitset=None):
+    """Bin-space routing (reference src/io/dense_bin.hpp Split /
+    include/LightGBM/bin.h threshold semantics): left iff bin <= threshold,
+    with the missing bin routed by default_left; categorical membership via
+    bitset."""
+    num_left = binval <= threshold
+    if cat_bitset is not None:
+        word = cat_bitset[binval // 32]
+        cat_left = (word >> (binval % 32)) & 1
+        cat_dec = cat_left.astype(bool)
+    else:
+        cat_dec = jnp.zeros_like(num_left)
+    dec = jnp.where(is_cat, cat_dec, num_left)
+    is_miss = (binval == miss_bin) & (miss_bin >= 0) & ~is_cat
+    return jnp.where(is_miss, default_left, dec)
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def partition_leaf(bins_full: jax.Array, perm: jax.Array, start, count,
+                   feature, threshold, default_left, miss_bin, is_cat,
+                   cat_bitset, capacity: int):
+    """Stable-partition one leaf's rows by a split decision.
+
+    Returns (new_perm, left_count). Rows with decision True keep relative
+    order at the front of the window, False after them, padding stays at
+    the tail (reference ParallelPartitionRunner semantics).
+    """
+    n = perm.shape[0]
+    rows, valid, read_start = leaf_window(perm, start, count, capacity)
+    binval = bins_full[jnp.where(valid, rows, 0), feature].astype(jnp.int32)
+    go_left = _decision_go_left(binval, threshold, default_left, miss_bin,
+                                is_cat, cat_bitset)
+    # 4-way stable key: rows before the leaf window stay at the front in
+    # original order, then left, then right, then rows after the leaf +
+    # padding — so writing the whole window back leaves other leaves'
+    # rows exactly where they were
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    off = jnp.asarray(start, jnp.int32) - read_start
+    key = jnp.where(pos < off, 0,
+                    jnp.where(valid, jnp.where(go_left, 1, 2), 3)).astype(jnp.int8)
+    order = jnp.argsort(key, stable=True)
+    new_rows = rows[order]
+    left_count = jnp.sum(go_left & valid).astype(jnp.int32)
+    if capacity <= n:
+        perm = jax.lax.dynamic_update_slice(perm, new_rows, (read_start,))
+    else:
+        perm = jax.lax.dynamic_update_slice(perm, new_rows[:n], (0,))
+    return perm, left_count
+
+
+def next_capacity(count: int, minimum: int = 256) -> int:
+    """Power-of-two capacity bucket for a leaf size (bounds the number of
+    jit specializations to ~log2(N))."""
+    c = max(int(count), 1)
+    cap = minimum
+    while cap < c:
+        cap *= 2
+    return cap

@@ -1,0 +1,112 @@
+"""Vectorized tree traversal (training-time score updates + inference).
+
+TPU re-design of the reference's per-row node-chasing loops
+(reference: include/LightGBM/tree.h:265-345 NumericalDecision/
+CategoricalDecision/(+Inner bin-space variants), Tree::Predict /
+AddPredictionToScore, src/boosting/gbdt_prediction.cpp).
+
+All rows advance one tree level per iteration of a lax.while_loop: a
+gather of per-node metadata + a gather of the routed feature value per
+row, entirely on-device. Rows that have reached a leaf carry a negative
+node id (LightGBM's ``~leaf_index`` convention) and stop moving.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _bitset_lookup(bitset: jax.Array, boundaries: jax.Array, cat_idx, val):
+    """FindInBitset (reference include/LightGBM/utils/common.h) over the
+    packed per-node uint32 bitset pool."""
+    begin = boundaries[cat_idx]
+    n_words = boundaries[cat_idx + 1] - begin
+    word_i = val // 32
+    in_range = (word_i < n_words) & (val >= 0)
+    word = bitset[begin + jnp.where(in_range, word_i, 0)]
+    bit = (word >> (val % 32).astype(jnp.uint32)) & 1
+    return (bit == 1) & in_range
+
+
+@functools.partial(jax.jit, static_argnames=())
+def traverse_binned(bins: jax.Array, split_feature: jax.Array,
+                    threshold_bin: jax.Array, left_child: jax.Array,
+                    right_child: jax.Array, default_left: jax.Array,
+                    miss_bin: jax.Array, is_cat: jax.Array,
+                    cat_bitset_inner: jax.Array,
+                    cat_boundaries_inner: jax.Array) -> jax.Array:
+    """Leaf index per row over bin codes (reference
+    NumericalDecisionInner/CategoricalDecisionInner, tree.h:285-330).
+
+    bins: [N, F_used]; per-node arrays are the flat tree. Returns [N]
+    int32 leaf indices.
+    """
+    n = bins.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nid = jnp.maximum(node, 0)
+        f = split_feature[nid]
+        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        thr = threshold_bin[nid]
+        mb = miss_bin[nid]
+        go_left = b <= thr
+        is_missing = (b == mb) & (mb >= 0)
+        go_left = jnp.where(is_missing, default_left[nid], go_left)
+        cat_left = _bitset_lookup(cat_bitset_inner, cat_boundaries_inner,
+                                  thr, b)
+        go_left = jnp.where(is_cat[nid], cat_left, go_left)
+        nxt = jnp.where(go_left, left_child[nid], right_child[nid])
+        return jnp.where(node < 0, node, nxt)
+
+    node = jax.lax.while_loop(cond, body, node)
+    return -node - 1
+
+
+@functools.partial(jax.jit, static_argnames=())
+def traverse_raw(x: jax.Array, split_feature: jax.Array,
+                 threshold: jax.Array, left_child: jax.Array,
+                 right_child: jax.Array, default_left: jax.Array,
+                 missing_type: jax.Array, is_cat: jax.Array,
+                 cat_bitset: jax.Array, cat_boundaries: jax.Array,
+                 cat_idx: jax.Array) -> jax.Array:
+    """Leaf index per row over raw feature values (reference
+    NumericalDecision/CategoricalDecision, tree.h:265-320).
+
+    x: [N, F_total] float; thresholds are real-valued; missing_type per
+    node in {0 none, 1 zero, 2 nan}. Returns [N] int32 leaf indices.
+    """
+    n = x.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+    K_ZERO = 1e-35
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nid = jnp.maximum(node, 0)
+        f = split_feature[nid]
+        v = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+        mt = missing_type[nid]
+        nan = jnp.isnan(v)
+        v_num = jnp.where(nan & (mt != 2), 0.0, v)
+        is_zero = jnp.abs(v_num) <= K_ZERO
+        is_missing = ((mt == 1) & is_zero) | ((mt == 2) & nan)
+        go_left = jnp.where(is_missing, default_left[nid],
+                            v_num <= threshold[nid])
+        # categorical: v<0 or (NaN & missing_nan) -> right; NaN else -> 0
+        iv = jnp.where(nan, 0, v).astype(jnp.int32)
+        cat_left = _bitset_lookup(cat_bitset, cat_boundaries, cat_idx[nid], iv)
+        cat_left = cat_left & ~(jnp.where(nan, False, v < 0)) \
+            & ~(nan & (mt == 2))
+        go_left = jnp.where(is_cat[nid], cat_left, go_left)
+        nxt = jnp.where(go_left, left_child[nid], right_child[nid])
+        return jnp.where(node < 0, node, nxt)
+
+    node = jax.lax.while_loop(cond, body, node)
+    return -node - 1
