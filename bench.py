@@ -1,0 +1,92 @@
+"""Benchmark: HIGGS-shaped binary training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): reference LightGBM trains HIGGS (10.5M rows x 28
+features, num_leaves=255, max_bin=255, 500 iterations) in 130.094 s on a
+2x E5-2690v4 CPU box (docs/Experiments.rst:113). We time the same
+configuration on a row-scaled synthetic HIGGS stand-in (no dataset
+downloads in this environment; zero egress) and report the extrapolated
+full-HIGGS wall-clock ratio: vs_baseline > 1 means faster than the
+reference CPU.
+
+Scale-up is linear in rows x iterations for the histogram-dominated
+leaf-wise algorithm (per-tree cost ~ sum of smaller-child row counts),
+so extrapolation = measured * (10.5e6/ROWS) * (500/ITERS).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+COLS = 28
+ITERS = int(os.environ.get("BENCH_ITERS", 100))
+BASELINE_SECONDS = 130.094
+FULL_ROWS, FULL_ITERS = 10_500_000, 500
+
+
+def make_higgs_like(n, f, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) * (rng.rand(f) > 0.3)
+    logit = X @ w * 0.5 + 0.7 * np.sin(X[:, 0] * 2) * X[:, 1]
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(ROWS, COLS)
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "metric": "auc",
+        "verbose": -1,
+        "min_data_in_leaf": 20,
+    }
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+
+    # warm-up: compile the kernel set on a few iterations
+    warm = lgb.train(dict(params), lgb.Dataset(X[:ROWS // 4], label=y[:ROWS // 4]),
+                     num_boost_round=3, verbose_eval=False)
+    del warm
+
+    t0 = time.time()
+    bst = lgb.train(params, ds, num_boost_round=ITERS, verbose_eval=False)
+    elapsed = time.time() - t0
+
+    # quality sanity: training AUC must be decent or the speed is a lie
+    idx = np.random.RandomState(1).choice(ROWS, size=min(ROWS, 200_000),
+                                          replace=False)
+    p = bst.predict(X[idx])
+    order = np.argsort(-p)
+    yy = y[idx][order] > 0
+    pos = yy.sum()
+    neg = len(yy) - pos
+    ranks = np.arange(1, len(yy) + 1)
+    auc = 1.0 - (np.sum(ranks[yy]) - pos * (pos + 1) / 2) / (pos * neg)
+
+    extrapolated = elapsed * (FULL_ROWS / ROWS) * (FULL_ITERS / ITERS)
+    result = {
+        "metric": "higgs_train_wallclock_extrapolated",
+        "value": round(extrapolated, 2),
+        "unit": "seconds",
+        "vs_baseline": round(BASELINE_SECONDS / extrapolated, 4),
+    }
+    print(json.dumps(result))
+    print(f"# measured {elapsed:.1f}s for {ROWS} rows x {ITERS} iters, "
+          f"train-AUC(sample)={auc:.4f}", file=sys.stderr)
+    if auc < 0.70:
+        print("# WARNING: AUC sanity check failed", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,30 +15,32 @@ __version__ = "0.1.0"
 
 from .config import Config
 from .utils import log
-from .utils.log import LightGBMError
+from .basic import Booster, Dataset, LightGBMError
+from .engine import CVBooster, cv, train
 
 __all__ = [
     "Config",
     "LightGBMError",
+    "Dataset",
+    "Booster",
+    "train",
+    "cv",
+    "CVBooster",
     "__version__",
 ]
 
+try:
+    from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
+                          LGBMRanker, LGBMRegressor)
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # sklearn not installed
+    pass
 
-def _register_api():
-    """Late-bound re-exports (populated as modules land)."""
-    global __all__
-    try:
-        from .basic import Booster, Dataset  # noqa: F401
-        from .engine import CVBooster, cv, train  # noqa: F401
-        __all__ += ["Dataset", "Booster", "train", "cv", "CVBooster"]
-    except ImportError:
-        pass
-    try:
-        from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
-                              LGBMRanker, LGBMRegressor)
-        __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
-    except ImportError:
-        pass
-
-
-_register_api()
+try:
+    from .plotting import (plot_importance, plot_metric,  # noqa: F401
+                           plot_split_value_histogram, plot_tree,
+                           create_tree_digraph)
+    __all__ += ["plot_importance", "plot_metric", "plot_split_value_histogram",
+                "plot_tree", "create_tree_digraph"]
+except ImportError:  # matplotlib/graphviz not installed
+    pass

@@ -1,0 +1,5 @@
+"""python -m lightgbm_tpu — the CLI entry point (reference src/main.cpp)."""
+from .cli import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,728 @@
+"""GBDT boosting driver and variants (DART, GOSS, RF).
+
+TPU re-design of the reference boosting layer (reference:
+src/boosting/gbdt.cpp — Init :42, TrainOneIter :337, BoostFromAverage
+:312, UpdateScore :458, RollbackOneIter :421; goss.hpp:25; dart.hpp:23;
+rf.hpp:25; model text IO gbdt_model_text.cpp:306 SaveModelToString /
+:410 LoadModelFromString).
+
+Scores live on-device as [num_tree_per_iteration, N] float32 arrays; a
+tree's contribution is applied with one vectorized binned traversal +
+leaf-value gather (replacing ScoreUpdater::AddScore's partition-indexed
+adds, score_updater.hpp:88). Objective gradient computation is a jitted
+program over the score array. The host drives the iteration loop.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.dataset import BinnedDataset
+from ..io.binning import BIN_CATEGORICAL
+from ..models.tree import Tree
+from ..objective.functions import ObjectiveFunction
+from ..metric.metrics import Metric
+from ..treelearner.serial import SerialTreeGrower
+from ..utils import log
+
+K_EPSILON = 1e-15
+K_MODEL_VERSION = "v3"
+
+
+class _ScoreState:
+    """Per-dataset score accumulator (reference score_updater.hpp:21)."""
+
+    def __init__(self, dataset: BinnedDataset, num_trees_per_iter: int) -> None:
+        self.dataset = dataset
+        self.num_data = dataset.num_data
+        init = np.zeros((num_trees_per_iter, dataset.num_data), dtype=np.float32)
+        if dataset.metadata.init_score is not None:
+            isc = np.asarray(dataset.metadata.init_score, dtype=np.float32)
+            init += isc.reshape(num_trees_per_iter, dataset.num_data)
+            self.has_init_score = True
+        else:
+            self.has_init_score = False
+        self.score = jnp.asarray(init)
+
+    def add_constant(self, val: float, class_id: int) -> None:
+        self.score = self.score.at[class_id].add(jnp.float32(val))
+
+    def add_tree(self, tree: Tree, class_id: int, miss_bin_map: np.ndarray) -> None:
+        leaf_idx = tree.leaf_index_binned(self.dataset.device_bins(), miss_bin_map)
+        vals = tree.leaf_values_device()
+        self.score = self.score.at[class_id].add(vals[leaf_idx])
+
+
+class GBDT:
+    """The boosting driver (reference gbdt.h:34)."""
+
+    def __init__(self) -> None:
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.config: Optional[Config] = None
+        self.train_data: Optional[BinnedDataset] = None
+        self.objective: Optional[ObjectiveFunction] = None
+        self.metrics: List[Metric] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_score: List[_ScoreState] = []
+        self.best_iter = 0
+        self.average_output = False
+        self.loaded_parameter = ""
+        self.feature_names_: List[str] = []
+        self.label_idx = 0
+
+    # ------------------------------------------------------------------
+    def init(self, config: Config, train_data: BinnedDataset,
+             objective: Optional[ObjectiveFunction],
+             metrics: Sequence[Metric]) -> None:
+        """reference GBDT::Init (gbdt.cpp:42)."""
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.num_data = train_data.num_data
+        self.num_tree_per_iteration = (
+            objective.num_tree_per_iteration if objective is not None
+            else max(config.num_class, 1))
+        self.shrinkage_rate = config.learning_rate
+        self.metrics = list(metrics)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names_ = list(train_data.feature_names)
+
+        if objective is not None:
+            objective.init(train_data.metadata, self.num_data)
+        for m in self.metrics:
+            m.init(train_data.metadata, self.num_data)
+
+        self.tree_learner = self._create_tree_learner(config, train_data)
+        self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
+        self.class_need_train = [True] * self.num_tree_per_iteration
+
+        # bagging state (reference GBDT::ResetBaggingConfig, gbdt.cpp:700)
+        self._bag_rng = np.random.RandomState(config.bagging_seed)
+        self.bag_data_cnt = self.num_data
+        self._full_perm = jnp.arange(self.num_data, dtype=jnp.int32)
+        self._perm = self._full_perm
+        self._reset_boosting_state()
+
+    def _create_tree_learner(self, config: Config, train_data: BinnedDataset):
+        if config.tree_learner in ("serial", "feature", "data", "voting"):
+            if config.tree_learner != "serial" and config.num_machines <= 1 \
+                    and not config.tpu_mesh_shape:
+                log.warning("Only one machine/chip: using serial tree learner")
+                return SerialTreeGrower(train_data, config)
+            if config.tree_learner == "serial":
+                return SerialTreeGrower(train_data, config)
+            from ..treelearner.parallel import create_parallel_learner
+            return create_parallel_learner(config.tree_learner, train_data, config)
+        log.fatal("Unknown tree learner type %s", config.tree_learner)
+
+    def _reset_boosting_state(self) -> None:
+        self._grad: Optional[jax.Array] = None
+        self._hess: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------
+    def add_valid_data(self, valid_data: BinnedDataset,
+                       metrics: Sequence[Metric]) -> None:
+        for m in metrics:
+            m.init(valid_data.metadata, valid_data.num_data)
+        self.valid_metrics.append(list(metrics))
+        self.valid_score.append(_ScoreState(valid_data, self.num_tree_per_iteration))
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        """reference GBDT::BoostFromAverage (gbdt.cpp:312)."""
+        cfg = self.config
+        if self.models or self.train_score.has_init_score or self.objective is None:
+            return 0.0
+        if cfg.boost_from_average or self.train_data.num_features == 0:
+            init_score = self.objective.boost_from_score(class_id)
+            if abs(init_score) > K_EPSILON:
+                if update_scorer:
+                    self.train_score.add_constant(init_score, class_id)
+                    for vs in self.valid_score:
+                        vs.add_constant(init_score, class_id)
+                log.info("Start training from score %f", init_score)
+                return init_score
+        elif self.objective.name in ("regression_l1", "quantile", "mape"):
+            log.warning("Disabling boost_from_average in %s may cause the slow convergence",
+                        self.objective.name)
+        return 0.0
+
+    def _boosting(self) -> None:
+        """Objective gradients from the current score (GBDT::Boosting,
+        gbdt.cpp:151)."""
+        if self.objective is None:
+            log.fatal("No objective function provided")
+        score = self.get_training_score()
+        if self.num_tree_per_iteration == 1:
+            g, h = self.objective.get_gradients(score[0])
+            self._grad, self._hess = g[None, :], h[None, :]
+        else:
+            self._grad, self._hess = self.objective.get_gradients(score)
+
+    def get_training_score(self) -> jax.Array:
+        return self.train_score.score
+
+    # ------------------------------------------------------------------
+    def _bagging(self, iteration: int) -> None:
+        """Per-iteration row subsetting (reference GBDT::Bagging,
+        gbdt.cpp:209; pos/neg bagging for binary)."""
+        cfg = self.config
+        need = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        if not need or iteration % cfg.bagging_freq != 0:
+            return
+        n = self.num_data
+        if cfg.pos_bagging_fraction != 1.0 or cfg.neg_bagging_fraction != 1.0:
+            label = np.asarray(self.train_data.metadata.label)
+            is_pos = label > 0
+            r = self._bag_rng.rand(n)
+            keep = np.where(is_pos, r < cfg.pos_bagging_fraction,
+                            r < cfg.neg_bagging_fraction)
+            bag = np.flatnonzero(keep)
+        else:
+            cnt = max(1, int(n * cfg.bagging_fraction))
+            bag = self._bag_rng.choice(n, size=cnt, replace=False)
+            bag.sort()
+        oob = np.setdiff1d(np.arange(n, dtype=np.int64), bag, assume_unique=True)
+        perm = np.concatenate([bag, oob]).astype(np.int32)
+        self._perm = jnp.asarray(perm)
+        self.bag_data_cnt = len(bag)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference GBDT::TrainOneIter,
+        gbdt.cpp:337). Returns True when training should stop."""
+        k = self.num_tree_per_iteration
+        init_scores = [0.0] * k
+        if gradients is None or hessians is None:
+            for c in range(k):
+                init_scores[c] = self._boost_from_average(c, True)
+            self._boosting()
+        else:
+            g = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, self.num_data))
+            h = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, self.num_data))
+            self._grad, self._hess = g, h
+
+        self._bagging(self.iter)
+
+        should_continue = False
+        for c in range(k):
+            if self.class_need_train[c] and self.train_data.num_features > 0:
+                new_tree = self.tree_learner.grow(
+                    self._grad[c], self._hess[c], self._perm, self.bag_data_cnt)
+            else:
+                new_tree = Tree(2)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                self._renew_tree_output(new_tree, c)
+                new_tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_score(new_tree, c)
+                if abs(init_scores[c]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[c])
+            else:
+                # constant-tree path (reference gbdt.cpp:389-407)
+                if len(self.models) < k:
+                    output = init_scores[c]
+                    if not self.class_need_train[c] and self.objective is not None:
+                        output = self.objective.boost_from_score(c)
+                    new_tree.set_leaf_value(0, output)
+                    self.train_score.add_constant(output, c)
+                    for vs in self.valid_score:
+                        vs.add_constant(output, c)
+            self.models.append(new_tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > k:
+                del self.models[-k:]
+            return True
+        self.iter += 1
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """reference GBDT::RollbackOneIter (gbdt.cpp:421)."""
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        miss = self.tree_learner.feature_miss_bin
+        for c in range(k):
+            tree = self.models[len(self.models) - k + c]
+            tree.apply_shrinkage(-1.0)
+            self.train_score.add_tree(tree, c, miss)
+            for vs in self.valid_score:
+                vs.add_tree(tree, c, miss)
+        del self.models[-k:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def _renew_tree_output(self, tree: Tree, class_id: int) -> None:
+        """Objective-specific leaf refit (reference
+        SerialTreeLearner::RenewTreeOutput, serial_tree_learner.cpp:661;
+        percentile refits for L1/quantile/MAPE)."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output:
+            return
+        miss = self.tree_learner.feature_miss_bin
+        leaf_idx = np.asarray(tree.leaf_index_binned(
+            self.train_data.device_bins(), miss))
+        score = np.asarray(self.train_score.score[class_id])
+        label = np.asarray(self.train_data.metadata.label)
+        residual = label - score
+        if self.bag_data_cnt < self.num_data:
+            bag_rows = np.asarray(self._perm[:self.bag_data_cnt])
+            out = obj.renew_tree_output(leaf_idx[bag_rows], residual[bag_rows],
+                                        tree.num_leaves)
+        else:
+            out = obj.renew_tree_output(leaf_idx, residual, tree.num_leaves)
+        if out is not None:
+            tree.leaf_value[:tree.num_leaves] = out
+            tree._device = None
+
+    def _update_score(self, tree: Tree, class_id: int) -> None:
+        """reference GBDT::UpdateScore (gbdt.cpp:458): train + valid."""
+        miss = self.tree_learner.feature_miss_bin
+        self.train_score.add_tree(tree, class_id, miss)
+        for vs in self.valid_score:
+            vs.add_tree(tree, class_id, miss)
+
+    # ------------------------------------------------------------------
+    def eval_at_iter(self) -> Dict[str, List[Tuple[str, str, float, bool]]]:
+        """All metric values: list of (dataset_name, metric_name, value,
+        bigger_is_better)."""
+        out = []
+        div = 1.0
+        if self.average_output and self.current_iteration > 0:
+            div = float(self.current_iteration)
+        if self.metrics:
+            sc = np.asarray(self.train_score.score) / div
+            for m in self.metrics:
+                for name, val in m.eval(sc[0] if sc.shape[0] == 1 else sc,
+                                        self.objective):
+                    out.append(("training", name, val, m.bigger_is_better))
+        for i, ms in enumerate(self.valid_metrics):
+            sc = np.asarray(self.valid_score[i].score) / div
+            for m in ms:
+                for name, val in m.eval(sc[0] if sc.shape[0] == 1 else sc,
+                                        self.objective):
+                    out.append((f"valid_{i}", name, val, m.bigger_is_better))
+        return out
+
+    # ------------------------------------------------------------------
+    # prediction (reference gbdt_prediction.cpp + c_api predict paths)
+    # ------------------------------------------------------------------
+    def _used_models(self, start_iteration: int, num_iteration: int):
+        k = self.num_tree_per_iteration
+        total = len(self.models) // k
+        start = max(0, min(start_iteration, total))
+        if num_iteration > 0:
+            end = min(start + num_iteration, total)
+        else:
+            end = total
+        return self.models[start * k:end * k]
+
+    def predict_raw(self, x: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw scores [N] or [N, num_class]."""
+        x = jnp.asarray(np.asarray(x, dtype=np.float32))
+        n = x.shape[0]
+        k = self.num_tree_per_iteration
+        score = jnp.zeros((k, n), dtype=jnp.float32)
+        models = self._used_models(start_iteration, num_iteration)
+        for i, tree in enumerate(models):
+            c = i % k
+            leaf = tree.leaf_index_raw(x)
+            score = score.at[c].add(tree.leaf_values_device()[leaf])
+        out = np.asarray(score, dtype=np.float64)
+        if self.average_output and models:
+            out /= len(models) // k
+        return out[0] if k == 1 else out.T
+
+    def predict(self, x: np.ndarray, start_iteration: int = 0,
+                num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(x, start_iteration, num_iteration)
+        if self.objective is not None:
+            conv = self.objective.convert_output(jnp.asarray(raw))
+            out = np.asarray(conv, dtype=np.float64)
+            return out
+        return raw
+
+    def predict_leaf_index(self, x: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        x = jnp.asarray(np.asarray(x, dtype=np.float32))
+        models = self._used_models(start_iteration, num_iteration)
+        out = np.empty((x.shape[0], len(models)), dtype=np.int32)
+        for i, tree in enumerate(models):
+            out[:, i] = np.asarray(tree.leaf_index_raw(x))
+        return out
+
+    def predict_contrib(self, x: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP values (reference Tree::PredictContrib / tree.cpp
+        TreeSHAP recursion), computed per tree on the host."""
+        from ..models.shap import tree_shap
+        xx = np.asarray(x, dtype=np.float64)
+        n = xx.shape[0]
+        k = self.num_tree_per_iteration
+        nf = self.max_feature_idx + 1
+        out = np.zeros((k, n, nf + 1))
+        models = self._used_models(start_iteration, num_iteration)
+        for i, tree in enumerate(models):
+            out[i % k] += tree_shap(tree, xx)
+        if k == 1:
+            return out[0]
+        # multiclass layout: per row, contribs of every class side by side
+        return np.concatenate([out[c] for c in range(k)], axis=1)
+
+    def num_predict(self, num_row: int, predict_leaf: bool, predict_contrib: bool) -> int:
+        k = self.num_tree_per_iteration
+        if predict_contrib:
+            return num_row * k * (self.max_feature_idx + 2)
+        if predict_leaf:
+            return num_row * len(self.models)
+        return num_row * k
+
+    # ------------------------------------------------------------------
+    # model IO (reference gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def _feature_infos(self) -> List[str]:
+        ds = self.train_data
+        infos = ["none"] * (self.max_feature_idx + 1)
+        if ds is None:
+            return getattr(self, "_loaded_feature_infos", infos)
+        for i, f in enumerate(ds.real_feature_index):
+            m = ds.bin_mappers[i]
+            if m.bin_type == BIN_CATEGORICAL:
+                infos[f] = ":".join(str(c) for c in m.bin_2_categorical)
+            else:
+                infos[f] = f"[{m.min_val}:{m.max_val}]"
+        return infos
+
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             importance_type: int = 0) -> str:
+        lines = ["tree", f"version={K_MODEL_VERSION}",
+                 f"num_class={self.config.num_class if self.config else self.num_tree_per_iteration}",
+                 f"num_tree_per_iteration={self.num_tree_per_iteration}",
+                 f"label_index={self.label_idx}",
+                 f"max_feature_idx={self.max_feature_idx}"]
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names_))
+        lines.append("feature_infos=" + " ".join(self._feature_infos()))
+
+        models = self._used_models(start_iteration, num_iteration)
+        tree_strs = []
+        for i, t in enumerate(models):
+            tree_strs.append(f"Tree={i}\n" + t.to_string())
+        sizes = [len(s) + 1 for s in tree_strs]
+        lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
+        lines.append("")
+        body = "\n".join(s for s in tree_strs)
+        tail = ["end of trees", ""]
+        imp = self.feature_importance(importance_type, num_iteration)
+        pairs = [(int(v), self.feature_names_[i]) for i, v in enumerate(imp) if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        tail.append("feature_importances:")
+        for v, nm in pairs:
+            tail.append(f"{nm}={v}")
+        tail.append("")
+        tail.append("parameters:")
+        tail.append(self.config.to_params_string() if self.config else self.loaded_parameter)
+        tail.append("end of parameters")
+        return "\n".join(lines) + "\n" + body + "\n" + "\n".join(tail) + "\n"
+
+    def save_model_to_file(self, filename: str, start_iteration: int = 0,
+                           num_iteration: int = -1, importance_type: int = 0) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.save_model_to_string(start_iteration, num_iteration,
+                                               importance_type))
+
+    def load_model_from_string(self, text: str) -> None:
+        """reference GBDT::LoadModelFromString (gbdt_model_text.cpp:410)."""
+        head, _, rest = text.partition("\ntree_sizes=")
+        kv: Dict[str, str] = {}
+        for line in head.splitlines():
+            if "=" in line:
+                key, val = line.split("=", 1)
+                kv[key.strip()] = val
+            elif line.strip() == "average_output":
+                self.average_output = True
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", "1"))
+        self._loaded_num_class = int(kv.get("num_class", "1"))
+        self.label_idx = int(kv.get("label_index", "0"))
+        self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
+        self.feature_names_ = kv.get("feature_names", "").split()
+        self._loaded_feature_infos = kv.get("feature_infos", "").split()
+        self._loaded_objective = kv.get("objective", "")
+        if self._loaded_objective:
+            from ..objective.functions import create_objective
+            name = self._loaded_objective.split()[0]
+            params: Dict[str, object] = {"objective": name, "verbosity": -1}
+            for tok in self._loaded_objective.split()[1:]:
+                if ":" in tok:
+                    pk, pv = tok.split(":", 1)
+                    params[pk] = pv
+                elif tok == "sqrt":
+                    params["reg_sqrt"] = True
+            if name in ("multiclass", "multiclassova"):
+                params["num_class"] = self._loaded_num_class
+            try:
+                cfg = Config.from_params(params)
+                self.objective = create_objective(cfg)
+            except BaseException:
+                self.objective = None
+        self.models = []
+        body = text[text.index("tree_sizes="):]
+        trees = body.split("Tree=")[1:]
+        for blk in trees:
+            blk = blk.split("end of trees")[0]
+            self.models.append(Tree.from_string(blk.partition("\n")[2]))
+        self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+        self.num_init_iteration = self.iter
+        pstart = text.find("\nparameters:")
+        if pstart >= 0:
+            self.loaded_parameter = text[pstart + len("\nparameters:"):]\
+                .split("end of parameters")[0].strip()
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        """0 = split count, 1 = total gain (reference
+        GBDT::FeatureImportance, gbdt.cpp:756)."""
+        nf = self.max_feature_idx + 1
+        out = np.zeros(nf)
+        models = self._used_models(0, num_iteration)
+        for tree in models:
+            ni = tree.num_leaves - 1
+            for i in range(ni):
+                f = int(tree.split_feature[i])
+                if importance_type == 0:
+                    if tree.split_gain[i] > 0:
+                        out[f] += 1.0
+                else:
+                    out[f] += max(float(tree.split_gain[i]), 0.0)
+        return out
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def refit_tree(self, tree_leaf_prediction: np.ndarray) -> None:
+        """reference GBDT::RefitTree (gbdt.cpp:266): re-fit leaf values
+        of the existing structure with new gradients."""
+        from ..ops.split import threshold_l1
+        cfg = self.config
+        leaf_pred = np.asarray(tree_leaf_prediction, dtype=np.int64)
+        self._boosting()
+        grad = np.asarray(self._grad)
+        hess = np.asarray(self._hess)
+        k = self.num_tree_per_iteration
+        for i, tree in enumerate(self.models):
+            c = i % k
+            lp = leaf_pred[:, i]
+            nl = tree.num_leaves
+            gs = np.bincount(lp, weights=grad[c], minlength=nl)
+            hs = np.bincount(lp, weights=hess[c], minlength=nl)
+            for leaf in range(nl):
+                g, h = gs[leaf], hs[leaf]
+                if cfg.lambda_l1 > 0:
+                    g = np.sign(g) * max(0.0, abs(g) - cfg.lambda_l1)
+                new_out = -g / (h + cfg.lambda_l2)
+                old = tree.leaf_value[leaf]
+                tree.set_leaf_value(
+                    leaf, cfg.refit_decay_rate * old
+                    + (1.0 - cfg.refit_decay_rate) * new_out * self.shrinkage_rate)
+            self._update_score(tree, c)
+
+
+class DART(GBDT):
+    """Dropout boosting (reference dart.hpp:23)."""
+
+    def init(self, config, train_data, objective, metrics):
+        super().init(config, train_data, objective, metrics)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        self.shrinkage_rate = config.learning_rate
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if gradients is None or hessians is None:
+            self._dropping_trees()
+        res = super().train_one_iter(gradients, hessians)
+        if not res:
+            self._normalize()
+            if not self.config.uniform_drop:
+                self.tree_weight.append(self.shrinkage_rate)
+                self.sum_weight += self.shrinkage_rate
+        return res
+
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        self.drop_index = []
+        if self._drop_rng.rand() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.tree_weight:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        cfg.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter):
+                        if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(self.num_init_iteration + i)
+                            if len(self.drop_index) >= cfg.max_drop:
+                                break
+            else:
+                if cfg.max_drop > 0 and self.iter > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter))
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop:
+                            break
+        k = self.num_tree_per_iteration
+        miss = self.tree_learner.feature_miss_bin
+        for i in self.drop_index:
+            for c in range(k):
+                t = self.models[i * k + c]
+                t.apply_shrinkage(-1.0)
+                self.train_score.add_tree(t, c, miss)
+        if not self.config.xgboost_dart_mode:
+            self.shrinkage_rate = self.config.learning_rate / (1.0 + len(self.drop_index))
+        else:
+            if not self.drop_index:
+                self.shrinkage_rate = self.config.learning_rate
+            else:
+                self.shrinkage_rate = self.config.learning_rate / \
+                    (self.config.learning_rate + len(self.drop_index))
+
+    def _normalize(self) -> None:
+        cfg = self.config
+        k_drop = float(len(self.drop_index))
+        k = self.num_tree_per_iteration
+        miss = self.tree_learner.feature_miss_bin
+        for i in self.drop_index:
+            for c in range(k):
+                t = self.models[i * k + c]
+                if not cfg.xgboost_dart_mode:
+                    t.apply_shrinkage(1.0 / (k_drop + 1.0))
+                    for vs in self.valid_score:
+                        vs.add_tree(t, c, miss)
+                    t.apply_shrinkage(-k_drop)
+                    self.train_score.add_tree(t, c, miss)
+                else:
+                    t.apply_shrinkage(self.shrinkage_rate)
+                    for vs in self.valid_score:
+                        vs.add_tree(t, c, miss)
+                    t.apply_shrinkage(-k_drop / cfg.learning_rate)
+                    self.train_score.add_tree(t, c, miss)
+            if not cfg.uniform_drop:
+                j = i - self.num_init_iteration
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[j] / (k_drop + 1.0)
+                    self.tree_weight[j] *= k_drop / (k_drop + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[j] / (k_drop + cfg.learning_rate)
+                    self.tree_weight[j] *= k_drop / (k_drop + cfg.learning_rate)
+
+
+class GOSS(GBDT):
+    """Gradient-based One-Side Sampling (reference goss.hpp:25)."""
+
+    def init(self, config, train_data, objective, metrics):
+        super().init(config, train_data, objective, metrics)
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        if not (config.top_rate > 0 and config.other_rate > 0
+                and config.top_rate + config.other_rate <= 1.0):
+            log.fatal("Invalid top_rate/other_rate for GOSS")
+        log.info("Using GOSS")
+
+    def _bagging(self, iteration: int) -> None:
+        cfg = self.config
+        n = self.num_data
+        if iteration < int(1.0 / cfg.learning_rate):
+            self._perm = self._full_perm
+            self.bag_data_cnt = n
+            return
+        g = np.asarray(self._grad)
+        h = np.asarray(self._hess)
+        weight = np.sum(np.abs(g * h), axis=0)
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        thresh_idx = np.argpartition(-weight, top_k - 1)
+        top_rows = thresh_idx[:top_k]
+        rest_rows = thresh_idx[top_k:]
+        sampled = self._bag_rng.choice(rest_rows, size=min(other_k, len(rest_rows)),
+                                       replace=False)
+        multiply = (n - top_k) / other_k
+        gm = jnp.asarray(np.float32(multiply))
+        sam = jnp.asarray(sampled.astype(np.int32))
+        self._grad = self._grad.at[:, sam].multiply(gm)
+        self._hess = self._hess.at[:, sam].multiply(gm)
+        bag = np.concatenate([top_rows, sampled])
+        bag.sort()
+        oob = np.setdiff1d(np.arange(n), bag, assume_unique=False)
+        self._perm = jnp.asarray(np.concatenate([bag, oob]).astype(np.int32))
+        self.bag_data_cnt = len(bag)
+
+
+class RF(GBDT):
+    """Random forest mode (reference rf.hpp:25): constant baseline
+    gradients each iteration, no shrinkage, averaged output."""
+
+    def init(self, config, train_data, objective, metrics):
+        super().init(config, train_data, objective, metrics)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        if not (config.bagging_freq > 0 and config.bagging_fraction < 1.0):
+            log.fatal("Random forest needs bagging_freq > 0 and bagging_fraction < 1")
+
+    def _boosting(self) -> None:
+        # gradients from the constant init score, not the accumulated one
+        k = self.num_tree_per_iteration
+        if not hasattr(self, "_rf_base_score"):
+            init = np.zeros((k, self.num_data), dtype=np.float32)
+            for c in range(k):
+                init[c] = self.objective.boost_from_score(c)
+            self._rf_base_score = jnp.asarray(init)
+        if k == 1:
+            g, h = self.objective.get_gradients(self._rf_base_score[0])
+            self._grad, self._hess = g[None, :], h[None, :]
+        else:
+            self._grad, self._hess = self.objective.get_gradients(self._rf_base_score)
+
+    def _boost_from_average(self, class_id, update_scorer):
+        return 0.0
+
+    def _update_score(self, tree: Tree, class_id: int) -> None:
+        # averaged output: score accumulates tree outputs; final predict
+        # divides by iteration count (handled at predict via shrinkage)
+        super()._update_score(tree, class_id)
+
+
+def create_boosting(boosting_type: str) -> GBDT:
+    """reference Boosting::CreateBoosting (boosting.cpp:35)."""
+    if boosting_type == "gbdt":
+        return GBDT()
+    if boosting_type == "dart":
+        return DART()
+    if boosting_type == "goss":
+        return GOSS()
+    if boosting_type == "rf":
+        return RF()
+    log.fatal("Unknown boosting type %s", boosting_type)
+    return GBDT()

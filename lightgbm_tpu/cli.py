@@ -1,0 +1,159 @@
+"""Command-line application: train / predict / convert_model / refit.
+
+Re-implementation of the reference CLI layer (reference:
+src/application/application.cpp — argv + config-file parsing :49-82,
+task dispatch, InitTrain :164 with snapshotting, Predict :213 via the
+batch Predictor src/application/predictor.hpp:29; src/main.cpp). Usage
+mirrors the reference binary:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .config import Config
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """key=value args + config= file (reference application.cpp:49-82;
+    Config::KV2Map/Str2Map)."""
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown argument %s, ignored", arg)
+            continue
+        key, val = arg.split("=", 1)
+        params[key.strip()] = val.strip()
+    cfg_file = params.get("config", params.get("config_file", ""))
+    if cfg_file:
+        file_params: Dict[str, str] = {}
+        with open(cfg_file) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                key, val = line.split("=", 1)
+                file_params[key.strip()] = val.strip()
+        # CLI args take precedence over config file (reference :78-80)
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def run_train(config: Config, params: Dict[str, str]) -> None:
+    import lightgbm_tpu as lgb
+    from .callback import print_evaluation
+
+    train_set = lgb.Dataset(config.data, params=dict(params))
+    valid_sets = []
+    valid_names = []
+    for i, vf in enumerate(config.valid):
+        valid_sets.append(train_set.create_valid(vf))
+        valid_names.append(os.path.basename(vf))
+
+    callbacks = []
+    if config.snapshot_freq > 0:
+        out_model = config.output_model
+
+        def snapshot_cb(env):
+            if (env.iteration + 1) % config.snapshot_freq == 0:
+                path = f"{out_model}.snapshot_iter_{env.iteration + 1}"
+                env.model.save_model(path)
+                log.info("Saved snapshot to %s", path)
+        snapshot_cb.order = 50
+        callbacks.append(snapshot_cb)
+
+    booster = lgb.train(
+        dict(params), train_set,
+        num_boost_round=config.num_iterations,
+        valid_sets=valid_sets or None, valid_names=valid_names or None,
+        init_model=config.input_model if config.input_model else None,
+        early_stopping_rounds=config.early_stopping_round or None,
+        verbose_eval=max(config.metric_freq, 1),
+        callbacks=callbacks or None)
+    booster.save_model(config.output_model)
+    log.info("Finished training, model saved to %s", config.output_model)
+
+
+def run_predict(config: Config, params: Dict[str, str]) -> None:
+    import lightgbm_tpu as lgb
+    from .io.text_loader import load_text_file
+
+    if not config.input_model:
+        log.fatal("task=predict requires input_model")
+    booster = lgb.Booster(model_file=config.input_model)
+    mat, _, _, _ = load_text_file(config.data, config)
+    preds = booster.predict(
+        mat, raw_score=config.predict_raw_score,
+        pred_leaf=config.predict_leaf_index,
+        pred_contrib=config.predict_contrib,
+        start_iteration=config.start_iteration_predict,
+        num_iteration=config.num_iteration_predict)
+    preds = np.atleast_2d(np.asarray(preds))
+    if preds.shape[0] == 1:
+        preds = preds.T
+    with open(config.output_result, "w") as fh:
+        for row in preds:
+            fh.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
+    log.info("Finished prediction, results saved to %s", config.output_result)
+
+
+def run_convert_model(config: Config, params: Dict[str, str]) -> None:
+    """Model -> standalone C++ if-else code (reference
+    gbdt_model_text.cpp:127 SaveModelToIfElse)."""
+    import lightgbm_tpu as lgb
+    from .models.codegen import model_to_cpp
+
+    if not config.input_model:
+        log.fatal("task=convert_model requires input_model")
+    booster = lgb.Booster(model_file=config.input_model)
+    code = model_to_cpp(booster._gbdt)
+    with open(config.convert_model, "w") as fh:
+        fh.write(code)
+    log.info("Converted model saved to %s", config.convert_model)
+
+
+def run_refit(config: Config, params: Dict[str, str]) -> None:
+    """reference application.cpp ConvertModel/refit task :214-239."""
+    import lightgbm_tpu as lgb
+    from .io.text_loader import load_text_file
+
+    if not config.input_model:
+        log.fatal("task=refit requires input_model")
+    booster = lgb.Booster(model_file=config.input_model,
+                          params=dict(params))
+    mat, label, weight, group = load_text_file(config.data, config)
+    new_booster = booster.refit(mat, label, decay_rate=config.refit_decay_rate)
+    new_booster.save_model(config.output_model)
+    log.info("Finished refit, model saved to %s", config.output_model)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_args(argv)
+    config = Config.from_params(params)
+    try:
+        if config.task == "train":
+            run_train(config, params)
+        elif config.task in ("predict", "prediction", "test"):
+            run_predict(config, params)
+        elif config.task == "convert_model":
+            run_convert_model(config, params)
+        elif config.task == "refit":
+            run_refit(config, params)
+        else:
+            log.fatal("Unknown task %s", config.task)
+    except Exception as e:  # mirror main.cpp catch-all
+        print(f"Met Exceptions:\n{e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

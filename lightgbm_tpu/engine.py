@@ -1,0 +1,341 @@
+"""Training entry points: train() and cv().
+
+API-compatible re-implementation of the reference engine
+(reference: python-package/lightgbm/engine.py — train() at :18 with the
+callback/early-stopping protocol, cv() at :394 with stratified folds and
+CVBooster at :280).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, LightGBMError
+from .config import _ALIASES
+from .utils import log
+
+
+def _resolve_num_boost_round(params: Dict[str, Any], default: int) -> int:
+    for alias in ("num_iterations", "num_iteration", "n_iter", "num_tree",
+                  "num_trees", "num_round", "num_rounds", "num_boost_round",
+                  "n_estimators"):
+        if alias in params:
+            return int(params.pop(alias))
+    return default
+
+
+def _resolve_early_stopping(params: Dict[str, Any],
+                            explicit: Optional[int]) -> Optional[int]:
+    for alias in ("early_stopping_round", "early_stopping_rounds",
+                  "early_stopping", "n_iter_no_change"):
+        if alias in params:
+            return int(params.pop(alias))
+    return explicit
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100, valid_sets=None, valid_names=None,
+          fobj=None, feval=None, init_model=None, feature_name: str = "auto",
+          categorical_feature: str = "auto",
+          early_stopping_rounds: Optional[int] = None, evals_result=None,
+          verbose_eval=True, learning_rates=None,
+          keep_training_booster: bool = False, callbacks=None) -> Booster:
+    """reference engine.py:18."""
+    params = copy.deepcopy(params) if params else {}
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+    early_stopping_rounds = _resolve_early_stopping(params, early_stopping_rounds)
+    first_metric_only = params.get("first_metric_only", False)
+
+    if fobj is not None:
+        params["objective"] = "none"
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    predictor_model = None
+    if init_model is not None:
+        if isinstance(init_model, str):
+            predictor_model = Booster(model_file=init_model)
+        elif isinstance(init_model, Booster):
+            predictor_model = init_model
+
+    # continued training: initialize train/valid scores by predicting the
+    # old model over the raw data (reference basic.py
+    # _set_init_score_by_predictor:1019)
+    if predictor_model is not None and train_set.init_score is None:
+        raw = train_set.data
+        if raw is None:
+            raise LightGBMError("Cannot continue training when the raw data "
+                                "was freed; pass free_raw_data=False")
+        init_score = predictor_model.predict(raw, raw_score=True)
+        train_set.init_score = init_score.T.reshape(-1) if init_score.ndim == 2 \
+            else init_score
+
+    booster = Booster(params=params, train_set=train_set)
+    if predictor_model is not None:
+        k = predictor_model._gbdt.num_tree_per_iteration
+        from .basic import copy_tree
+        booster._gbdt.models = [copy_tree(t) for t in predictor_model._gbdt.models] \
+            + booster._gbdt.models
+        booster._gbdt.num_init_iteration = len(predictor_model._gbdt.models) // k
+        booster._gbdt.iter = 0
+
+    valid_contain_train = False
+    train_data_name = "training"
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if valid_names is not None and isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if predictor_model is not None and vs.init_score is None \
+                    and vs.data is not None:
+                isc = predictor_model.predict(vs.data, raw_score=True)
+                vs.init_score = isc.T.reshape(-1) if isc.ndim == 2 else isc
+            name = valid_names[i] if valid_names is not None else f"valid_{i}"
+            booster.add_valid(vs, name)
+
+    cbs = set(callbacks) if callbacks else set()
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval is not False:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            first_metric_only,
+                                            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+
+    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_contain_train:
+            evaluation_result_list.extend(
+                (train_data_name, m, v, b)
+                for _, m, v, b in booster.eval_train(feval))
+        if booster.name_valid_sets:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+        if finished:
+            break
+
+    for ds_name, m_name, val, _ in (evaluation_result_list or []):
+        booster.best_score.setdefault(ds_name, collections.OrderedDict())
+        booster.best_score[ds_name][m_name] = val
+    if not keep_training_booster:
+        booster.free_dataset()
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:280)."""
+
+    def __init__(self) -> None:
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, fpreproc=None, stratified: bool = True,
+                  shuffle: bool = True, eval_train_metric: bool = False):
+    full_data = full_data.construct()
+    num_data = full_data.num_data()
+    group = full_data.get_group()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError("folds should be a generator or iterator of "
+                                 "(train_idx, test_idx) tuples or scikit-learn splitter")
+        if hasattr(folds, "split"):
+            folds = folds.split(X=np.empty(num_data), y=full_data.get_label(),
+                                groups=None)
+    else:
+        if group is not None:
+            # group-aware folds: whole queries assigned to folds
+            ng = len(group)
+            rng = np.random.RandomState(seed)
+            gidx = rng.permutation(ng) if shuffle else np.arange(ng)
+            bounds = np.concatenate([[0], np.cumsum(group)]).astype(np.int64)
+            fold_groups = np.array_split(gidx, nfold)
+            folds = []
+            for k in range(nfold):
+                test_g = set(fold_groups[k].tolist())
+                test_idx = np.concatenate(
+                    [np.arange(bounds[g], bounds[g + 1]) for g in sorted(test_g)]) \
+                    if test_g else np.empty(0, np.int64)
+                train_idx = np.setdiff1d(np.arange(num_data), test_idx)
+                folds.append((train_idx, test_idx))
+        elif stratified:
+            label = full_data.get_label()
+            rng = np.random.RandomState(seed)
+            folds = []
+            classes = np.unique(label)
+            assign = np.empty(num_data, dtype=np.int64)
+            for c in classes:
+                rows = np.flatnonzero(label == c)
+                if shuffle:
+                    rng.shuffle(rows)
+                assign[rows] = np.arange(len(rows)) % nfold
+            for k in range(nfold):
+                test_idx = np.flatnonzero(assign == k)
+                train_idx = np.flatnonzero(assign != k)
+                folds.append((train_idx, test_idx))
+        else:
+            rng = np.random.RandomState(seed)
+            idx = rng.permutation(num_data) if shuffle else np.arange(num_data)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.setdiff1d(np.arange(num_data), p), np.sort(p))
+                     for p in parts]
+
+    ret = CVBooster()
+    for train_idx, test_idx in folds:
+        train_sub = full_data.subset(np.sort(train_idx))
+        valid_sub = full_data.subset(np.sort(test_idx))
+        if group is not None:
+            bounds = np.concatenate([[0], np.cumsum(group)]).astype(np.int64)
+            qid_of_row = np.searchsorted(bounds, np.arange(num_data), side="right") - 1
+            tq = qid_of_row[np.sort(train_idx)]
+            vq = qid_of_row[np.sort(test_idx)]
+            train_sub.group = np.bincount(tq)[np.unique(tq)]
+            valid_sub.group = np.bincount(vq)[np.unique(vq)]
+        tparams = params
+        if fpreproc is not None:
+            train_sub, valid_sub, tparams = fpreproc(train_sub, valid_sub,
+                                                     copy.deepcopy(params))
+        booster = Booster(tparams, train_sub)
+        if eval_train_metric:
+            booster.add_valid(train_sub, "train")
+        booster.add_valid(valid_sub, "valid")
+        ret._append(booster)
+    return ret
+
+
+def _agg_cv_result(raw_results, eval_train_metric: bool = False):
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            if eval_train_metric:
+                key = f"{one_line[0]} {one_line[1]}"
+            else:
+                key = one_line[1]
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
+            for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name: str = "auto", categorical_feature: str = "auto",
+       early_stopping_rounds: Optional[int] = None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False):
+    """reference engine.py:394."""
+    params = copy.deepcopy(params) if params else {}
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    early_stopping_rounds = _resolve_early_stopping(params, early_stopping_rounds)
+    first_metric_only = params.get("first_metric_only", False)
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+    if isinstance(params.get("objective"), str) and \
+            params["objective"] in ("lambdarank", "rank_xendcg"):
+        stratified = False
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, folds, nfold, params, seed, fpreproc,
+                            stratified, shuffle, eval_train_metric)
+
+    cbs = set(callbacks) if callbacks else set()
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            first_metric_only, verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval is not False:
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(model=cvfolds, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=None))
+        for b in cvfolds.boosters:
+            b.update(fobj=fobj)
+        raw = [b.eval_valid(feval) + (b.eval_train(feval) if eval_train_metric else [])
+               for b in cvfolds.boosters]
+        raw = [[(n if eval_train_metric else n, m, v, bb) for n, m, v, bb in r]
+               for r in raw]
+        res = _agg_cv_result(raw, eval_train_metric)
+        for _, key, mean, _, std in res:
+            results[f"{key}-mean"].append(mean)
+            results[f"{key}-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(model=cvfolds, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=res))
+        except callback_mod.EarlyStopException as e:
+            cvfolds.best_iteration = e.best_iteration + 1
+            for bst in cvfolds.boosters:
+                bst.best_iteration = cvfolds.best_iteration
+            for k in results:
+                results[k] = results[k][:cvfolds.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvfolds
+    return out

@@ -1,0 +1,432 @@
+"""Evaluation metrics.
+
+Re-implementation of the reference metric layer (reference: src/metric/
+— factory metric.cpp:16-62; regression_metric.hpp pointwise losses,
+binary_metric.hpp incl. the sort-based AUC at :159, multiclass_metric.hpp,
+rank_metric.hpp NDCG/MAP, xentropy_metric.hpp). Metrics are evaluated
+host-side in numpy over the (converted) score array — they run once per
+``metric_freq`` iterations on O(N) data, far off the hot path, and
+float64 accumulation matches the reference's double sums.
+
+Each metric returns a list of (name, value) pairs;
+``bigger_is_better`` drives early stopping direction
+(factor_to_bigger_better in the reference).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+
+
+def _safe_log(x):
+    return np.log(np.maximum(x, 1e-308))
+
+
+class Metric:
+    name = "metric"
+    bigger_is_better = False
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = None if metadata.label is None else np.asarray(metadata.label)
+        self.weights = None if metadata.weights is None else np.asarray(metadata.weights)
+        self.sum_weights = float(np.sum(self.weights)) if self.weights is not None \
+            else float(num_data)
+        self.metadata = metadata
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+    def _convert(self, score, objective):
+        if objective is not None:
+            import jax.numpy as jnp
+            out = objective.convert_output(jnp.asarray(score))
+            return np.asarray(out, dtype=np.float64)
+        return np.asarray(score, dtype=np.float64)
+
+    def _avg(self, loss):
+        if self.weights is not None:
+            return float(np.sum(loss * self.weights) / self.sum_weights)
+        return float(np.mean(loss))
+
+
+# --- regression pointwise metrics (regression_metric.hpp) -----------------
+
+class _Pointwise(Metric):
+    convert = True
+
+    def loss(self, label, score):
+        raise NotImplementedError
+
+    def finalize(self, avg_loss):
+        return avg_loss
+
+    def eval(self, score, objective=None):
+        p = self._convert(score, objective) if self.convert else np.asarray(score)
+        val = self.finalize(self._avg(self.loss(self.label, p)))
+        return [(self.name, val)]
+
+
+class L2Metric(_Pointwise):
+    name = "l2"
+
+    def loss(self, y, p):
+        return (p - y) ** 2
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def finalize(self, avg):
+        return float(np.sqrt(avg))
+
+
+class L1Metric(_Pointwise):
+    name = "l1"
+
+    def loss(self, y, p):
+        return np.abs(p - y)
+
+
+class QuantileMetric(_Pointwise):
+    name = "quantile"
+
+    def loss(self, y, p):
+        delta = y - p
+        a = self.config.alpha
+        return np.where(delta < 0, (a - 1.0) * delta, a * delta)
+
+
+class HuberMetric(_Pointwise):
+    name = "huber"
+
+    def loss(self, y, p):
+        diff = p - y
+        a = self.config.alpha
+        return np.where(np.abs(diff) <= a, 0.5 * diff * diff,
+                        a * (np.abs(diff) - 0.5 * a))
+
+
+class FairMetric(_Pointwise):
+    name = "fair"
+
+    def loss(self, y, p):
+        x = np.abs(p - y)
+        c = self.config.fair_c
+        return c * x - c * c * np.log(1.0 + x / c)
+
+
+class PoissonMetric(_Pointwise):
+    name = "poisson"
+
+    def loss(self, y, p):
+        eps = 1e-10
+        p = np.maximum(p, eps)
+        return p - y * np.log(p)
+
+
+class MAPEMetric(_Pointwise):
+    name = "mape"
+
+    def loss(self, y, p):
+        return np.abs(y - p) / np.maximum(1.0, np.abs(y))
+
+
+class GammaMetric(_Pointwise):
+    name = "gamma"
+
+    def loss(self, y, p):
+        theta = -1.0 / np.maximum(p, 1e-300)
+        b = -_safe_log(-theta)
+        c = _safe_log(y) - _safe_log(y)  # psi=1: log(y/1) - log(y) = 0
+        return -((y * theta - b) + c)
+
+
+class GammaDevianceMetric(_Pointwise):
+    name = "gamma_deviance"
+
+    def loss(self, y, p):
+        tmp = y / (p + 1e-9)
+        return tmp - _safe_log(tmp) - 1.0
+
+    def finalize(self, avg):
+        # reference AverageLoss: sum_loss * 2 (NOT divided by weights)
+        return avg * self.sum_weights * 2 if self.weights is not None \
+            else avg * self.num_data * 2
+
+
+class TweedieMetric(_Pointwise):
+    name = "tweedie"
+
+    def loss(self, y, p):
+        rho = self.config.tweedie_variance_power
+        p = np.maximum(p, 1e-10)
+        return -y * np.power(p, 1 - rho) / (1 - rho) + \
+            np.power(p, 2 - rho) / (2 - rho)
+
+
+# --- binary metrics (binary_metric.hpp) -----------------------------------
+
+class BinaryLoglossMetric(_Pointwise):
+    name = "binary_logloss"
+
+    def loss(self, y, p):
+        is_pos = y > 0
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return np.where(is_pos, -np.log(p), -np.log(1 - p))
+
+
+class BinaryErrorMetric(_Pointwise):
+    name = "binary_error"
+
+    def loss(self, y, p):
+        pred_pos = p > 0.5
+        return (pred_pos != (y > 0)).astype(np.float64)
+
+
+class AUCMetric(Metric):
+    """Sort-based AUC (reference binary_metric.hpp:159-260)."""
+    name = "auc"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64)
+        y = (self.label > 0).astype(np.float64)
+        w = self.weights if self.weights is not None else np.ones_like(y)
+        order = np.argsort(-s, kind="stable")
+        s, y, w = s[order], y[order], w[order]
+        # group ties: average rank semantics via threshold blocks
+        pos_w = y * w
+        neg_w = (1 - y) * w
+        # unique thresholds
+        _, idx_start = np.unique(-s, return_index=True)
+        block = np.zeros(len(s), dtype=np.int64)
+        block[idx_start] = 1
+        block = np.cumsum(block) - 1
+        n_blocks = block[-1] + 1 if len(s) else 0
+        bp = np.bincount(block, weights=pos_w, minlength=n_blocks)
+        bn = np.bincount(block, weights=neg_w, minlength=n_blocks)
+        total_neg = neg_w.sum()
+        # correctly-ordered pairs: positives vs lower-scored negatives,
+        # ties (same block) count half
+        cum_neg_after = total_neg - np.cumsum(bn)
+        acc = np.sum(bp * (cum_neg_after + 0.5 * bn))
+        total_pos = pos_w.sum()
+        if total_pos <= 0 or total_neg <= 0:
+            log.warning("AUC: data contains only one class")
+            return [(self.name, 1.0)]
+        return [(self.name, float(acc / (total_pos * total_neg)))]
+
+
+# --- multiclass (multiclass_metric.hpp) -----------------------------------
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        p = self._convert_mc(score, objective)
+        lab = self.label.astype(np.int64)
+        rows = np.arange(len(lab))
+        loss = -_safe_log(np.clip(p[rows, lab], 1e-15, 1.0))
+        return [(self.name, self._avg(loss))]
+
+    def _convert_mc(self, score, objective):
+        """score arrives as [num_class, N]; convert to [N, num_class]
+        probabilities."""
+        s = np.asarray(score, dtype=np.float64)
+        if s.ndim == 1:
+            s = s.reshape(self.config.num_class, -1)
+        s = s.T
+        if objective is not None:
+            import jax.numpy as jnp
+            return np.asarray(objective.convert_output(jnp.asarray(s)))
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+
+class MultiErrorMetric(MultiLoglossMetric):
+    name = "multi_error"
+
+    def eval(self, score, objective=None):
+        p = self._convert_mc(score, objective)
+        lab = self.label.astype(np.int64)
+        k = max(1, self.config.multi_error_top_k)
+        rows = np.arange(len(lab))
+        # top-k error (reference: correct if true-class prob is among the
+        # k largest, ties counted favorably)
+        label_p = p[rows, lab]
+        rank = np.sum(p > label_p[:, None], axis=1)
+        err = (rank >= k).astype(np.float64)
+        return [(self.name, self._avg(err))]
+
+
+class AucMuMetric(Metric):
+    """Multiclass pairwise AUC (reference multiclass_metric.hpp auc_mu)."""
+    name = "auc_mu"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64)
+        nc = self.config.num_class
+        if s.ndim == 1:
+            s = s.reshape(nc, -1)
+        s = s.T  # [N, C]
+        lab = self.label.astype(np.int64)
+        w = self.weights if self.weights is not None else np.ones(len(lab))
+        aucs = []
+        for i in range(nc):
+            for j in range(i + 1, nc):
+                mask = (lab == i) | (lab == j)
+                if not mask.any():
+                    continue
+                # rank by score difference (class i vs j)
+                d = s[mask, i] - s[mask, j]
+                yy = (lab[mask] == i).astype(np.float64)
+                ww = w[mask]
+                order = np.argsort(-d, kind="stable")
+                yy, ww, dd = yy[order], ww[order], d[order]
+                pos = yy * ww
+                neg = (1 - yy) * ww
+                tn = neg.sum()
+                tp = pos.sum()
+                # tie-aware: group equal scores into blocks
+                starts = np.concatenate([[True], dd[1:] != dd[:-1]])
+                blk = np.cumsum(starts) - 1
+                nb = blk[-1] + 1 if len(blk) else 0
+                bp = np.bincount(blk, weights=pos, minlength=nb)
+                bn = np.bincount(blk, weights=neg, minlength=nb)
+                cum_after = tn - np.cumsum(bn)
+                if tp > 0 and tn > 0:
+                    aucs.append(float(np.sum(bp * (cum_after + 0.5 * bn))
+                                      / (tp * tn)))
+        return [(self.name, float(np.mean(aucs)) if aucs else 1.0)]
+
+
+# --- cross entropy (xentropy_metric.hpp) ----------------------------------
+
+class CrossEntropyMetric(_Pointwise):
+    name = "cross_entropy"
+
+    def loss(self, y, p):
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return -y * np.log(p) - (1 - y) * np.log(1 - p)
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64)
+        hhat = np.log1p(np.exp(s))
+        w = self.weights if self.weights is not None else 1.0
+        z = 1.0 - np.exp(-w * hhat)
+        z = np.clip(z, 1e-15, 1 - 1e-15)
+        y = self.label
+        loss = -y * np.log(z) - (1 - y) * np.log(1 - z)
+        return [(self.name, float(np.mean(loss)))]
+
+
+class KLDivMetric(_Pointwise):
+    name = "kldiv"
+
+    def loss(self, y, p):
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        yy = np.clip(y, 1e-15, 1 - 1e-15)
+        xent = -y * np.log(p) - (1 - y) * np.log(1 - p)
+        ent = -(yy * np.log(yy) + (1 - yy) * np.log(1 - yy))
+        return xent - ent
+
+
+# --- ranking (rank_metric.hpp) --------------------------------------------
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        from ..objective.rank import DCGCalculator
+        self.dcg = DCGCalculator(self.config.label_gain)
+        if metadata.query_boundaries is None:
+            log.fatal("NDCG metric requires query information")
+        self.boundaries = np.asarray(metadata.query_boundaries)
+        self.eval_at = list(self.config.eval_at)
+        # per-query weights (metadata query weights unsupported yet: uniform)
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64)
+        nq = len(self.boundaries) - 1
+        out = np.zeros(len(self.eval_at))
+        for q in range(nq):
+            b, e = self.boundaries[q], self.boundaries[q + 1]
+            lab = self.label[b:e]
+            for ki, k in enumerate(self.eval_at):
+                maxdcg = self.dcg.cal_max_dcg_at_k(k, lab)
+                if maxdcg <= 0:
+                    out[ki] += 1.0
+                else:
+                    out[ki] += self.dcg.cal_dcg_at_k(k, lab, s[b:e]) / maxdcg
+        return [(f"ndcg@{k}", float(out[ki] / nq))
+                for ki, k in enumerate(self.eval_at)]
+
+
+class MapMetric(Metric):
+    name = "map"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("MAP metric requires query information")
+        self.boundaries = np.asarray(metadata.query_boundaries)
+        self.eval_at = list(self.config.eval_at)
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64)
+        nq = len(self.boundaries) - 1
+        out = np.zeros(len(self.eval_at))
+        for q in range(nq):
+            b, e = self.boundaries[q], self.boundaries[q + 1]
+            lab = (self.label[b:e] > 0).astype(np.float64)
+            order = np.argsort(-s[b:e], kind="stable")
+            rel = lab[order]
+            hits = np.cumsum(rel)
+            prec = hits / (np.arange(len(rel)) + 1.0)
+            for ki, k in enumerate(self.eval_at):
+                kk = min(k, len(rel))
+                npos = rel[:kk].sum()
+                out[ki] += float(np.sum(prec[:kk] * rel[:kk]) / max(npos, 1.0))
+        return [(f"map@{k}", float(out[ki] / nq))
+                for ki, k in enumerate(self.eval_at)]
+
+
+# --- factory (metric.cpp:16) ----------------------------------------------
+
+_REGISTRY = {
+    "l2": L2Metric, "rmse": RMSEMetric, "l1": L1Metric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric, "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric, "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "auc_mu": AucMuMetric,
+    "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric, "kldiv": KLDivMetric,
+    "ndcg": NDCGMetric, "map": MapMetric,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        if name not in ("", "custom"):
+            log.warning("Unknown metric type name: %s", name)
+        return None
+    return cls(config)

@@ -1,0 +1,187 @@
+"""TreeSHAP feature contributions.
+
+Behavioral equivalent of the reference Tree::PredictContrib
+(reference: include/LightGBM/tree.h:138 + the TreeSHAP recursion in
+src/io/tree.cpp — the Lundberg & Lee path-dependent algorithm with
+EXTEND/UNWIND over the unique decision path, and the count-weighted
+ExpectedValue in the bias slot).
+
+Host-side implementation: SHAP is an inference-time explanation path,
+off the training hot loop; rows × trees × depth² work in numpy is the
+same complexity class as the reference's C++ per-row recursion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tree import Tree
+
+
+class _Path:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, n):
+        self.feature_index = np.zeros(n, dtype=np.int64)
+        self.zero_fraction = np.zeros(n)
+        self.one_fraction = np.zeros(n)
+        self.pweight = np.zeros(n)
+
+    def copy_from(self, other, n):
+        self.feature_index[:n] = other.feature_index[:n]
+        self.zero_fraction[:n] = other.zero_fraction[:n]
+        self.one_fraction[:n] = other.one_fraction[:n]
+        self.pweight[:n] = other.pweight[:n]
+
+
+def _extend(path: _Path, unique_depth: int, zero_fraction: float,
+            one_fraction: float, feature_index: int) -> None:
+    path.feature_index[unique_depth] = feature_index
+    path.zero_fraction[unique_depth] = zero_fraction
+    path.one_fraction[unique_depth] = one_fraction
+    path.pweight[unique_depth] = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path.pweight[i + 1] += one_fraction * path.pweight[i] * (i + 1) \
+            / (unique_depth + 1)
+        path.pweight[i] = zero_fraction * path.pweight[i] \
+            * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind(path: _Path, unique_depth: int, path_index: int) -> None:
+    one_fraction = path.one_fraction[path_index]
+    zero_fraction = path.zero_fraction[path_index]
+    next_one_portion = path.pweight[unique_depth]
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path.pweight[i]
+            path.pweight[i] = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path.pweight[i] * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path.pweight[i] = path.pweight[i] * (unique_depth + 1) \
+                / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path.feature_index[i] = path.feature_index[i + 1]
+        path.zero_fraction[i] = path.zero_fraction[i + 1]
+        path.one_fraction[i] = path.one_fraction[i + 1]
+
+
+def _unwound_sum(path: _Path, unique_depth: int, path_index: int) -> float:
+    one_fraction = path.one_fraction[path_index]
+    zero_fraction = path.zero_fraction[path_index]
+    next_one_portion = path.pweight[unique_depth]
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path.pweight[i] - tmp * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            total += path.pweight[i] / (zero_fraction * (unique_depth - i)
+                                        / (unique_depth + 1))
+    return total
+
+
+def _node_decision(tree: Tree, node: int, row: np.ndarray) -> bool:
+    """Same routing as Tree.predict_row for one node."""
+    v = row[tree.split_feature[node]]
+    if tree.is_categorical_node(node):
+        from .tree import _in_bitset
+        cat_idx = int(tree.threshold[node])
+        words = tree.cat_threshold[tree.cat_boundaries[cat_idx]:
+                                   tree.cat_boundaries[cat_idx + 1]]
+        if np.isnan(v):
+            return False
+        iv = int(v)
+        if iv < 0:
+            return False
+        return _in_bitset(words, iv)
+    mt = tree.missing_type(node)
+    fv = v
+    if np.isnan(fv) and mt != 2:
+        fv = 0.0
+    if (mt == 1 and abs(fv) <= 1e-35) or (mt == 2 and np.isnan(fv)):
+        return tree.default_left(node)
+    return fv <= tree.threshold[node]
+
+
+def expected_value(tree: Tree) -> float:
+    """Count-weighted mean output (reference Tree::ExpectedValue)."""
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    total = float(tree.internal_count[0])
+    k = tree.num_leaves
+    return float(np.sum(tree.leaf_count[:k] * tree.leaf_value[:k]) / total)
+
+
+def _tree_shap_row(tree: Tree, row: np.ndarray, phi: np.ndarray, node: int,
+                   unique_depth: int, parent_path: _Path,
+                   parent_zero_fraction: float, parent_one_fraction: float,
+                   parent_feature_index: int) -> None:
+    path = _Path(unique_depth + 2)
+    path.copy_from(parent_path, unique_depth)
+    _extend(path, unique_depth, parent_zero_fraction, parent_one_fraction,
+            parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_sum(path, unique_depth, i)
+            phi[path.feature_index[i]] += w * (path.one_fraction[i]
+                                               - path.zero_fraction[i]) \
+                * tree.leaf_value[leaf]
+        return
+
+    hot = tree.left_child[node] if _node_decision(tree, node, row) \
+        else tree.right_child[node]
+    cold = tree.right_child[node] if _node_decision(tree, node, row) \
+        else tree.left_child[node]
+    w_node = float(tree.internal_count[node])
+    hot_count = float(_child_count(tree, int(hot)))
+    cold_count = float(_child_count(tree, int(cold)))
+
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+    split_index = int(tree.split_feature[node])
+    # undo previous extension if we have already seen this feature
+    path_index = 1
+    while path_index <= unique_depth:
+        if path.feature_index[path_index] == split_index:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path.zero_fraction[path_index]
+        incoming_one_fraction = path.one_fraction[path_index]
+        _unwind(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap_row(tree, row, phi, int(hot), unique_depth + 1, path,
+                   hot_count / w_node * incoming_zero_fraction,
+                   incoming_one_fraction, split_index)
+    _tree_shap_row(tree, row, phi, int(cold), unique_depth + 1, path,
+                   cold_count / w_node * incoming_zero_fraction, 0.0,
+                   split_index)
+
+
+def _child_count(tree: Tree, child: int) -> int:
+    if child < 0:
+        return int(tree.leaf_count[~child])
+    return int(tree.internal_count[child])
+
+
+def tree_shap(tree: Tree, x: np.ndarray) -> np.ndarray:
+    """SHAP contributions for a batch: [N, num_total_features + 1]
+    (last column = expected value / bias)."""
+    n = x.shape[0]
+    nf = int(max(tree.split_feature[:max(tree.num_nodes, 1)].max(initial=0),
+                 x.shape[1] - 1)) + 1
+    out = np.zeros((n, x.shape[1] + 1))
+    ev = expected_value(tree)
+    out[:, -1] = ev
+    if tree.num_nodes == 0:
+        return out
+    root_path = _Path(1)
+    for r in range(n):
+        _tree_shap_row(tree, x[r], out[r], 0, 0, root_path, 1.0, 1.0, -1)
+    return out

@@ -238,7 +238,7 @@ def numerical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
     out_r = pick(r_res[2], f_res[2])
     lg = pick(r_res[3], f_res[3])
     lh = pick(r_res[4], f_res[4])
-    lcnt = pick(r_res[5].astype(jnp.float32), f_res[5].astype(jnp.float32)).astype(jnp.int32)
+    lcnt = pick(r_res[5], f_res[5])  # int arrays select exactly
 
     default_left = is_rev
     # NaN missing with num_bin<=2: single reverse scan but missing routes right
@@ -265,7 +265,8 @@ def numerical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
 
 
 def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
-                           sum_g, sum_h, num_data, parent_output, cmin, cmax):
+                           sum_g, sum_h, num_data, parent_output, cmin, cmax,
+                           rand_thresholds: Optional[jax.Array] = None):
     """Best categorical split per feature
     (reference FindBestThresholdCategoricalInner,
     feature_histogram.hpp:278-515).
@@ -319,8 +320,17 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
 
     use_onehot = (nb <= cfg.max_cat_to_onehot)
 
+    # extra_trees: restrict to one random candidate per feature
+    # (reference USE_RAND in FindBestThresholdCategoricalInner; the
+    # numerical rand draw is reused modulo the categorical bounds)
+    if cfg.extra_trees and rand_thresholds is not None:
+        rt = rand_thresholds[:, None]
+        oh_rand_ok = bin_ar == (1 + jnp.mod(rt, jnp.maximum(nb - 1, 1)))
+    else:
+        oh_rand_ok = jnp.ones_like(valid_bin)
+
     # ---- one-vs-rest: left = single category bin t, original l2 -----
-    oh = eval_lr(g, h, cnt, valid_bin & use_onehot, cfg)
+    oh = eval_lr(g, h, cnt, valid_bin & use_onehot & oh_rand_ok, cfg)
 
     # ---- sorted many-vs-many ----------------------------------------
     usable = valid_bin & (cnt >= cfg.cat_smooth)
@@ -354,6 +364,14 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
                                 (inc.T, lc_ok.T))
         return fires.T
 
+    if cfg.extra_trees and rand_thresholds is not None:
+        max_num = jnp.maximum(jnp.minimum(
+            jnp.minimum(cfg.max_cat_threshold, (used_bin + 1) // 2),
+            used_bin) - 1, 1)[:, None]
+        sorted_rand_ok = pos_ar == jnp.mod(rand_thresholds[:, None], max_num)
+    else:
+        sorted_rand_ok = jnp.ones((f, b_dim), dtype=bool)
+
     def directional(sgd, shd, scd):
         lg = jnp.cumsum(sgd, axis=1)
         lh = jnp.cumsum(shd, axis=1)
@@ -361,6 +379,7 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
         rcnt = num_data - lc
         ok = (pos_ar < jnp.minimum(used_bin[:, None], max_num_cat)) \
             & ~use_onehot \
+            & sorted_rand_ok \
             & (rcnt >= cfg.min_data_per_group) \
             & group_thinning(lc)
         return eval_lr(lg, lh, lc, ok, cat_cfg)
@@ -425,7 +444,7 @@ def best_split(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
                                parent_output, cmin, cmax, rand_thresholds)
     if any_categorical:
         cat = categorical_split_scan(hist, meta, cfg, sum_g, sum_h, num_data,
-                                     parent_output, cmin, cmax)
+                                     parent_output, cmin, cmax, rand_thresholds)
         is_cat = meta.is_categorical
         merged = {}
         for k in ("gain", "default_left", "left_sum_gradient",

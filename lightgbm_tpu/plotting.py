@@ -1,0 +1,283 @@
+"""Plotting utilities.
+
+API-compatible re-implementation of the reference plotting module
+(reference: python-package/lightgbm/plotting.py — plot_importance :37,
+plot_split_value_histogram :144, plot_metric :231, plot_tree /
+create_tree_digraph :549/:461 via graphviz).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Optional
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+from .sklearn import LGBMModel
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be Booster or LGBMModel.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2, xlim=None,
+                    ylim=None, title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """reference plotting.py:37."""
+    import matplotlib.pyplot as plt
+
+    booster = _to_booster(booster)
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_name = booster.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1 if values else 1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with @index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs):
+    """reference plotting.py:144."""
+    import matplotlib.pyplot as plt
+
+    booster = _to_booster(booster)
+    hist, split_bins = booster.get_split_value_histogram(feature, bins=bins,
+                                                         xgboost_style=False)
+    if np.count_nonzero(hist) == 0:
+        raise ValueError(f"Cannot plot split value histogram, "
+                         f"because feature {feature} was not used in splitting")
+    width = width_coef * (split_bins[1] - split_bins[0])
+    centred = (split_bins[:-1] + split_bins[1:]) / 2
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centred, hist, width=width, align="center", **kwargs)
+    if xlim is None:
+        range_result = split_bins[-1] - split_bins[0]
+        xlim = (split_bins[0] - range_result * 0.2,
+                split_bins[-1] + range_result * 0.2)
+    ax.set_xlim(xlim)
+    ax.set_ylim(ylim if ylim is not None else (0, max(hist) * 1.1))
+    if title is not None:
+        title = title.replace("@feature@", str(feature))
+        title = title.replace("@index/name@",
+                              "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names=None, ax=None, xlim=None, ylim=None,
+                title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "auto",
+                figsize=None, dpi=None, grid: bool = True):
+    """reference plotting.py:231."""
+    import matplotlib.pyplot as plt
+
+    if isinstance(booster, LGBMModel):
+        eval_results = deepcopy(booster.evals_result_)
+    elif isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif isinstance(booster, Booster):
+        raise TypeError("booster must be dict or LGBMModel. To use plot_metric "
+                        "with Booster type, first record the metrics using "
+                        "record_evaluation callback then pass that to plot_metric as argument `booster`")
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    if dataset_names is None:
+        dataset_names = iter(eval_results.keys())
+    elif not isinstance(dataset_names, (list, tuple, set)):
+        raise ValueError("dataset_names should be iterable and cannot be empty")
+    else:
+        dataset_names = iter(dataset_names)
+
+    name = next(dataset_names)
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError("more than one metric available, pick one with the 'metric' parameter")
+        metric, results = metrics_for_one.popitem()
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+
+    for name in dataset_names:
+        metrics_for_one = eval_results[name]
+        results = metrics_for_one[metric]
+        max_result = max(max(results), max_result)
+        min_result = min(min(results), min_result)
+        ax.plot(x_, results, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is None:
+        range_result = max_result - min_result
+        ylim = (min_result - range_result * 0.2, max_result + range_result * 0.2)
+    ax.set_ylim(ylim)
+    if ylabel == "auto":
+        ylabel = metric
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _to_graphviz(tree_info: dict, show_info, feature_names, precision=3,
+                 orientation="horizontal", **kwargs):
+    """reference plotting.py:380 _to_graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz and restart your session "
+                          "to plot tree.")
+
+    def add(root, total_count, parent=None, decision=None):
+        if "split_index" in root:
+            name = f"split{root['split_index']}"
+            if feature_names is not None:
+                label = f"<B>{feature_names[root['split_feature']]}</B>"
+            else:
+                label = f"feature <B>{root['split_feature']}</B>"
+            lbl = f"<{label} {root['decision_type']} "
+            lbl += f"<B>{_float2str(root['threshold'], precision)}</B>>"
+            graph.node(name, label=lbl)
+            add(root["left_child"], total_count, name, "yes")
+            add(root["right_child"], total_count, name, "no")
+        else:
+            name = f"leaf{root['leaf_index']}"
+            label = f"leaf {root['leaf_index']}: "
+            label += f"<B>{_float2str(root['leaf_value'], precision)}</B>"
+            if "leaf_count" in show_info and "leaf_count" in root:
+                label += f"<br/>count: {root['leaf_count']}"
+            graph.node(name, label=f"<{label}>")
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+    add(tree_info["tree_structure"], tree_info.get("num_leaves", 0))
+    return graph
+
+
+def _float2str(value, precision: int = 3) -> str:
+    return f"{value:.{precision}f}" if isinstance(value, float) else str(value)
+
+
+def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        **kwargs):
+    """reference plotting.py:461."""
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    feature_names = model.get("feature_names", None)
+    if tree_index < len(tree_infos):
+        tree_info = tree_infos[tree_index]
+    else:
+        raise IndexError("tree_index is out of range.")
+    if show_info is None:
+        show_info = []
+    return _to_graphviz(tree_info, show_info, feature_names, precision,
+                        orientation, **kwargs)
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info=None, precision: int = 3,
+              orientation: str = "horizontal", **kwargs):
+    """reference plotting.py:549."""
+    import matplotlib.image as mpimg
+    import matplotlib.pyplot as plt
+    import io
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    graph = create_tree_digraph(booster=booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation, **kwargs)
+    s = io.BytesIO()
+    s.write(graph.pipe(format="png"))
+    s.seek(0)
+    img = mpimg.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,73 @@
+"""Named-scope timing with an aggregated global table.
+
+Equivalent of the reference's Timer/FunctionTimer + global_timer
+(reference: include/LightGBM/utils/common.h:1054-1138 — RAII scopes
+around every hot function, aggregated by name, printed at exit when
+built with -DUSE_TIMETAG). Here the same scopes also emit
+jax.profiler.TraceAnnotation ranges so device traces line up with the
+host-side phase table.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict
+
+from . import log
+
+_ENABLED = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.acc: Dict[str, float] = defaultdict(float)
+        self.cnt: Dict[str, int] = defaultdict(int)
+        self.enabled = _ENABLED
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        try:
+            import jax.profiler
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.acc[name] += time.perf_counter() - t0
+            self.cnt[name] += 1
+            if ann is not None:
+                ann.__exit__(None, None, None)
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU timer table:"]
+        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
+            lines.append(f"  {name}: {self.acc[name]:.3f}s over {self.cnt[name]} calls")
+        return "\n".join(lines)
+
+    def print_at_exit(self) -> None:
+        if self.enabled and self.acc:
+            log.info("%s", self.report())
+
+
+global_timer = Timer()
+atexit.register(global_timer.print_at_exit)
+
+
+def function_timer(name: str):
+    """Decorator form (reference Common::FunctionTimer)."""
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            with global_timer.scope(name):
+                return fn(*args, **kwargs)
+        wrapper.__name__ = fn.__name__
+        return wrapper
+    return deco

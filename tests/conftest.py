@@ -1,14 +1,24 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh.
 
-Must set the environment BEFORE jax is imported anywhere, so this sits at
-the top of conftest (mirrors the driver's multi-chip dry-run environment).
+The environment's axon TPU plugin (sitecustomize in /root/.axon_site)
+overrides ``jax_platforms`` via jax.config.update at interpreter start,
+so setting the env var is not enough — re-update the config before any
+backend initializes. This mirrors the driver's multi-chip dry-run
+environment (JAX_PLATFORMS=cpu + xla_force_host_platform_device_count).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

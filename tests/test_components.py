@@ -1,0 +1,211 @@
+"""Tests for auxiliary components: CLI, forced splits, CEGB, codegen,
+SHAP oracle, tree serialization, timer."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+P = {"verbose": -1, "min_data_in_leaf": 20}
+
+
+def make_binary(n=1500, f=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (1.5 * X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def test_forced_splits(tmp_path):
+    X, y = make_binary()
+    fs = {"feature": 3, "threshold": 0.0,
+          "left": {"feature": 4, "threshold": 0.5}}
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump(fs, fh)
+    bst = lgb.train(dict(P, objective="binary", forcedsplits_filename=path),
+                    lgb.Dataset(X, label=y), num_boost_round=3,
+                    verbose_eval=False)
+    for t in bst._gbdt.models:
+        # root split must be on feature 3, its left child on feature 4
+        assert int(t.split_feature[0]) == 3
+        assert int(t.left_child[0]) == 1
+        assert int(t.split_feature[1]) == 4
+
+
+def test_cegb_penalty_reduces_feature_use():
+    X, y = make_binary()
+    # massively penalize all features except 0 and 1
+    coupled = [0.0, 0.0] + [1e5] * 4
+    b = lgb.train(dict(P, objective="binary", cegb_tradeoff=1.0,
+                       cegb_penalty_feature_coupled=coupled),
+                  lgb.Dataset(X, label=y), num_boost_round=10,
+                  verbose_eval=False)
+    imp = b.feature_importance("split")
+    assert imp[2:].sum() == 0
+    assert imp[:2].sum() > 0
+
+
+def test_cegb_split_penalty_shrinks_trees():
+    X, y = make_binary()
+    b0 = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                   num_boost_round=5, verbose_eval=False)
+    b1 = lgb.train(dict(P, objective="binary", cegb_penalty_split=10.0),
+                   lgb.Dataset(X, label=y), num_boost_round=5,
+                   verbose_eval=False)
+    assert sum(t.num_leaves for t in b1._gbdt.models) < \
+        sum(t.num_leaves for t in b0._gbdt.models)
+
+
+def test_cli_train_predict_roundtrip(tmp_path):
+    X, y = make_binary(800)
+    data_path = str(tmp_path / "train.csv")
+    np.savetxt(data_path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    model_path = str(tmp_path / "model.txt")
+    out_path = str(tmp_path / "preds.txt")
+
+    from lightgbm_tpu.cli import main
+    rc = main([f"data={data_path}", "objective=binary", "num_iterations=5",
+               f"output_model={model_path}", "verbosity=-1", "task=train"])
+    assert rc == 0
+    assert os.path.exists(model_path)
+    rc = main(["task=predict", f"data={data_path}",
+               f"input_model={model_path}", f"output_result={out_path}",
+               "verbosity=-1"])
+    assert rc == 0
+    preds = np.loadtxt(out_path)
+    assert preds.shape[0] == 800
+    assert ((preds > 0.5) == y).mean() > 0.9
+
+
+def test_cli_config_file(tmp_path):
+    X, y = make_binary(500)
+    data_path = str(tmp_path / "train.csv")
+    np.savetxt(data_path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    conf = str(tmp_path / "train.conf")
+    model_path = str(tmp_path / "m.txt")
+    with open(conf, "w") as fh:
+        fh.write(f"task = train\nobjective = binary\ndata = {data_path}\n"
+                 f"num_trees = 3\noutput_model = {model_path}\n"
+                 "verbosity = -1\n")
+    from lightgbm_tpu.cli import main
+    assert main([f"config={conf}"]) == 0
+    assert os.path.exists(model_path)
+
+
+def test_convert_model_cpp(tmp_path):
+    X, y = make_binary(500)
+    bst = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                    num_boost_round=3, verbose_eval=False)
+    from lightgbm_tpu.models.codegen import model_to_cpp
+    code = model_to_cpp(bst._gbdt)
+    assert "PredictTree0" in code and "PredictTree2" in code
+    assert "void Predict(" in code
+    # compile it to be sure it's valid C++
+    src = tmp_path / "model.cc"
+    src.write_text(code + "\nint main(){double a[6]={0};double o[1];"
+                   "Predict(a,o);return o[0]>1e9;}\n")
+    import shutil
+    if shutil.which("g++"):
+        subprocess.run(["g++", "-std=c++14", "-o", str(tmp_path / "m"),
+                        str(src)], check=True)
+        subprocess.run([str(tmp_path / "m")], check=True)
+
+
+def test_shap_vs_bruteforce_small():
+    """Exact Shapley by enumeration on a tiny tree vs TreeSHAP."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 3)
+    y = 1.0 * (X[:, 0] > 0) + 0.5 * (X[:, 1] > 0.5)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 10, "num_leaves": 4},
+                    lgb.Dataset(X, label=y), num_boost_round=1,
+                    verbose_eval=False)
+    tree = bst._gbdt.models[0]
+    from lightgbm_tpu.models.shap import tree_shap
+    contrib = tree_shap(tree, X[:5])
+    # additivity: contributions + bias == prediction
+    for r in range(5):
+        pred = tree.predict_row(X[r])
+        np.testing.assert_allclose(contrib[r].sum(), pred, rtol=1e-6)
+
+
+def test_tree_text_roundtrip():
+    X, y = make_binary(500)
+    bst = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                    num_boost_round=2, verbose_eval=False)
+    t = bst._gbdt.models[0]
+    from lightgbm_tpu.models.tree import Tree
+    s = t.to_string()
+    t2 = Tree.from_string(s)
+    assert t2.num_leaves == t.num_leaves
+    np.testing.assert_allclose(t2.leaf_value[:t.num_leaves],
+                               t.leaf_value[:t.num_leaves])
+    np.testing.assert_array_equal(t2.split_feature[:t.num_nodes],
+                                  t.split_feature[:t.num_nodes])
+    for r in range(20):
+        np.testing.assert_allclose(t2.predict_row(X[r]), t.predict_row(X[r]),
+                                   rtol=1e-9)
+
+
+def test_model_text_has_reference_fields():
+    X, y = make_binary(400)
+    bst = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                    num_boost_round=2, verbose_eval=False)
+    s = bst.model_to_string()
+    for field in ("tree\n", "num_class=", "num_tree_per_iteration=",
+                  "max_feature_idx=", "objective=binary", "feature_names=",
+                  "feature_infos=", "tree_sizes=", "end of trees",
+                  "feature_importances:", "parameters:"):
+        assert field in s, field
+    for field in ("num_leaves=", "split_feature=", "threshold=",
+                  "decision_type=", "left_child=", "right_child=",
+                  "leaf_value=", "internal_count=", "shrinkage="):
+        assert field in s, field
+
+
+def test_traversal_matches_predict_row():
+    """Vectorized raw traversal vs the scalar oracle on NaN-rich data."""
+    rng = np.random.RandomState(6)
+    X = rng.randn(400, 5)
+    X[rng.rand(400) < 0.3, 2] = np.nan
+    y = (np.nan_to_num(X[:, 2], nan=1.0) + X[:, 0] > 0).astype(float)
+    bst = lgb.train(dict(P, objective="binary", min_data_in_leaf=5),
+                    lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False)
+    raw = bst.predict(X, raw_score=True)
+    want = np.zeros(len(X))
+    for t in bst._gbdt.models:
+        for r in range(len(X)):
+            want[r] += t.predict_row(X[r])
+    np.testing.assert_allclose(raw, want, rtol=1e-5, atol=1e-5)
+
+
+def test_timer_table():
+    os.environ["LGBM_TPU_TIMETAG"] = "1"
+    import importlib
+    from lightgbm_tpu.utils import timer as timer_mod
+    importlib.reload(timer_mod)
+    with timer_mod.global_timer.scope("unit_test_scope"):
+        pass
+    rep = timer_mod.global_timer.report()
+    assert "unit_test_scope" in rep
+    os.environ.pop("LGBM_TPU_TIMETAG")
+
+
+def test_refit():
+    X, y = make_binary(800)
+    bst = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                    num_boost_round=5, verbose_eval=False)
+    rng = np.random.RandomState(1)
+    y2 = np.where(rng.rand(800) < 0.1, 1 - y, y)
+    nb = bst.refit(X, y2, decay_rate=0.5)
+    assert nb.num_trees() == bst.num_trees()
+    # structure unchanged
+    for t1, t2 in zip(bst._gbdt.models, nb._gbdt.models):
+        np.testing.assert_array_equal(t1.split_feature[:t1.num_nodes],
+                                      t2.split_feature[:t2.num_nodes])

@@ -1,0 +1,94 @@
+"""Multi-chip parallel learner tests on the virtual 8-device CPU mesh.
+
+The reference had NO automated distributed tests (SURVEY §4: socket/MPI
+paths exercised manually via examples/parallel_learning). On TPU a pod
+slice is one process, so the data/voting/feature-parallel learners run
+in CI directly — this is a capability the reference lacked.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.treelearner.parallel import (
+    DataParallelTreeGrower, FeatureParallelTreeGrower,
+    VotingParallelTreeGrower, build_mesh)
+
+
+def make_binary(n=3000, f=8, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def auc_score(y, p):
+    order = np.argsort(-p, kind="stable")
+    yy = y[order] > 0
+    pos = yy.sum()
+    neg = len(yy) - pos
+    ranks = np.arange(1, len(yy) + 1)
+    return 1.0 - (np.sum(ranks[yy]) - pos * (pos + 1) / 2) / (pos * neg)
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return jax.devices()
+
+
+def _train_with_learner(learner_name, X, y, rounds=15):
+    params = {"objective": "binary", "verbose": -1,
+              "tree_learner": learner_name, "num_machines": 8,
+              "min_data_in_leaf": 20, "metric": "auc"}
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False)
+
+
+def test_data_parallel_quality(eight_devices):
+    X, y = make_binary()
+    bst = _train_with_learner("data", X, y)
+    assert auc_score(y, bst.predict(X)) > 0.97
+
+
+def test_data_parallel_close_to_serial(eight_devices):
+    X, y = make_binary(2000)
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20}
+    b_serial = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                         verbose_eval=False)
+    b_dp = _train_with_learner("data", X, y, rounds=5)
+    ps = b_serial.predict(X, raw_score=True)
+    pd = b_dp.predict(X, raw_score=True)
+    # same global histograms (modulo f32 reduction order) => nearly
+    # identical trees
+    assert np.corrcoef(ps, pd)[0, 1] > 0.999
+
+def test_voting_parallel_quality(eight_devices):
+    X, y = make_binary()
+    bst = _train_with_learner("voting", X, y)
+    assert auc_score(y, bst.predict(X)) > 0.96
+
+
+def test_feature_parallel_quality(eight_devices):
+    X, y = make_binary()
+    bst = _train_with_learner("feature", X, y)
+    assert auc_score(y, bst.predict(X)) > 0.97
+
+
+def test_data_parallel_with_bagging(eight_devices):
+    X, y = make_binary()
+    params = {"objective": "binary", "verbose": -1, "tree_learner": "data",
+              "num_machines": 8, "bagging_fraction": 0.5, "bagging_freq": 1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False)
+    assert auc_score(y, bst.predict(X)) > 0.95
+
+
+def test_mesh_build(eight_devices):
+    cfg = Config.from_params({"tpu_mesh_shape": "8"})
+    mesh = build_mesh(cfg)
+    assert mesh.shape["data"] == 8
