@@ -499,6 +499,7 @@ class Booster:
     def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
         """reference basic.py:2873 Booster.refit."""
         mat = _to_2d_numpy(data)
+        self._gbdt._materialize_models()
         leaf = self._gbdt.predict_leaf_index(mat, 0, -1)
         new_params = dict(self.params)
         new_params["refit_decay_rate"] = decay_rate
@@ -568,6 +569,7 @@ class Booster:
             fidx = self.feature_name().index(feature)
         else:
             fidx = int(feature)
+        self._gbdt._materialize_models()
         values = []
         for t in self._gbdt.models:
             ni = t.num_leaves - 1
@@ -586,6 +588,7 @@ class Booster:
     def trees_to_dataframe(self):
         """reference basic.py:2132."""
         import pandas as pd
+        self._gbdt._materialize_models()
         rows = []
         fn = self.feature_name()
         for ti, t in enumerate(self._gbdt.models):

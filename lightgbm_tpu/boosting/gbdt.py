@@ -100,6 +100,13 @@ class GBDT:
             m.init(train_data.metadata, self.num_data)
 
         self.tree_learner = self._create_tree_learner(config, train_data)
+        # fused single-dispatch path (treelearner/fused.py): mandatory for
+        # remote-accelerator latency; host-loop grower covers the rest
+        from ..treelearner.fused import FusedSerialGrower, fused_supported
+        self._fused = None
+        if fused_supported(config, train_data, objective):
+            self._fused = FusedSerialGrower(train_data, config)
+        self._fused_check_every = 50
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
 
@@ -214,6 +221,9 @@ class GBDT:
 
         self._bagging(self.iter)
 
+        if self._fused is not None:
+            return self._train_one_iter_fused(init_scores)
+
         should_continue = False
         for c in range(k):
             if self.class_need_train[c] and self.train_data.num_features > 0:
@@ -249,8 +259,49 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _train_one_iter_fused(self, init_scores) -> bool:
+        """Fused path: one device dispatch per class-tree, zero
+        synchronous host transfers (trees stay on device as PendingTree
+        until a host consumer needs them)."""
+        from ..treelearner.fused import PendingTree
+        k = self.num_tree_per_iteration
+        for c in range(k):
+            ta, leaf_of_row = self._fused.grow_device(
+                self._grad[c], self._hess[c], self._perm, self.bag_data_cnt)
+            pending = PendingTree(self._fused, ta)
+            pending.apply_shrinkage(self.shrinkage_rate)
+            vals = pending.leaf_values_device()
+            self.train_score.score = \
+                self.train_score.score.at[c].add(vals[leaf_of_row])
+            for vs in self.valid_score:
+                vleaf = self._fused._valid_traverse_jit(
+                    ta, vs.dataset.device_bins())
+                vs.score = vs.score.at[c].add(vals[vleaf])
+            if abs(init_scores[c]) > K_EPSILON:
+                pending.add_bias(init_scores[c])
+            self.models.append(pending)
+        self.iter += 1
+        # deferred no-more-splits detection: syncing every iteration
+        # would cost a tunnel round trip, so check periodically
+        if self.iter % self._fused_check_every == 0:
+            if int(self.models[-1].tree_arrays["n_leaves"]) <= 1:
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                del self.models[-k:]
+                self.iter -= 1
+                return True
+        return False
+
+    def _materialize_models(self) -> None:
+        """Swap PendingTree entries for concrete host Trees."""
+        from ..treelearner.fused import PendingTree
+        for i, t in enumerate(self.models):
+            if isinstance(t, PendingTree):
+                self.models[i] = t.materialize()
+
     def rollback_one_iter(self) -> None:
         """reference GBDT::RollbackOneIter (gbdt.cpp:421)."""
+        self._materialize_models()
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration
@@ -321,6 +372,7 @@ class GBDT:
     # prediction (reference gbdt_prediction.cpp + c_api predict paths)
     # ------------------------------------------------------------------
     def _used_models(self, start_iteration: int, num_iteration: int):
+        self._materialize_models()
         k = self.num_tree_per_iteration
         total = len(self.models) // k
         start = max(0, min(start_iteration, total))
@@ -525,6 +577,7 @@ class GBDT:
         from ..ops.split import threshold_l1
         cfg = self.config
         leaf_pred = np.asarray(tree_leaf_prediction, dtype=np.int64)
+        self._materialize_models()
         self._boosting()
         grad = np.asarray(self._grad)
         hess = np.asarray(self._hess)
@@ -595,6 +648,7 @@ class DART(GBDT):
                             break
         k = self.num_tree_per_iteration
         miss = self.tree_learner.feature_miss_bin
+        self._materialize_models()
         for i in self.drop_index:
             for c in range(k):
                 t = self.models[i * k + c]
@@ -614,6 +668,7 @@ class DART(GBDT):
         k_drop = float(len(self.drop_index))
         k = self.num_tree_per_iteration
         miss = self.tree_learner.feature_miss_bin
+        self._materialize_models()
         for i in self.drop_index:
             for c in range(k):
                 t = self.models[i * k + c]

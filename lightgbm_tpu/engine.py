@@ -82,6 +82,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if predictor_model is not None:
         k = predictor_model._gbdt.num_tree_per_iteration
         from .basic import copy_tree
+        predictor_model._gbdt._materialize_models()
         booster._gbdt.models = [copy_tree(t) for t in predictor_model._gbdt.models] \
             + booster._gbdt.models
         booster._gbdt.num_init_iteration = len(predictor_model._gbdt.models) // k
