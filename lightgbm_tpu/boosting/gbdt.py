@@ -106,7 +106,7 @@ class GBDT:
         self._fused = None
         if fused_supported(config, train_data, objective):
             self._fused = FusedSerialGrower(train_data, config)
-        self._fused_check_every = 50
+        self._fused_check_every = 10
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
 
@@ -282,15 +282,39 @@ class GBDT:
             self.models.append(pending)
         self.iter += 1
         # deferred no-more-splits detection: syncing every iteration
-        # would cost a tunnel round trip, so check periodically
+        # would cost a tunnel round trip, so check periodically and
+        # roll back ALL trailing degenerate iterations on detection
         if self.iter % self._fused_check_every == 0:
-            if int(self.models[-1].tree_arrays["n_leaves"]) <= 1:
+            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-k:]):
+                self._trim_degenerate_tail()
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
-                del self.models[-k:]
-                self.iter -= 1
                 return True
         return False
+
+    @staticmethod
+    def _tree_num_leaves(t) -> int:
+        """Leaf count without forcing a full host materialization."""
+        from ..treelearner.fused import PendingTree
+        if isinstance(t, PendingTree) and t._tree is None:
+            return int(jax.device_get(t.tree_arrays["n_leaves"]))
+        return t.num_leaves
+
+    def _trim_degenerate_tail(self) -> int:
+        """Delete every trailing iteration whose trees are all single
+        leaves (the fused path trains blind between periodic stop
+        checks; the reference rolls back at the first degenerate
+        iteration — gbdt.cpp:389-407)."""
+        k = self.num_tree_per_iteration
+        removed = 0
+        while len(self.models) > k:
+            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-k:]):
+                del self.models[-k:]
+                self.iter -= 1
+                removed += 1
+            else:
+                break
+        return removed
 
     def _materialize_models(self) -> None:
         """Swap PendingTree entries for concrete host Trees."""
@@ -715,6 +739,7 @@ class GOSS(GBDT):
             return
         g = np.asarray(self._grad)
         h = np.asarray(self._hess)
+        # sum_c |g*h| (reference goss.hpp:111 accumulates fabs per class)
         weight = np.sum(np.abs(g * h), axis=0)
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))

@@ -155,6 +155,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if finished:
             break
 
+    # fused path trains blind between periodic stop checks; drop any
+    # trailing all-degenerate iterations it may have accumulated
+    if getattr(booster._gbdt, "_fused", None) is not None:
+        booster._gbdt._trim_degenerate_tail()
+
     for ds_name, m_name, val, _ in (evaluation_result_list or []):
         booster.best_score.setdefault(ds_name, collections.OrderedDict())
         booster.best_score[ds_name][m_name] = val

@@ -507,29 +507,53 @@ class FusedSerialGrower:
 
 class PendingTree:
     """Lazily-materialized device tree: keeps the raw device arrays until
-    a host consumer (save/predict/importance) needs a real Tree, so the
-    training loop never blocks on a device→host fetch."""
+    a host consumer needs a real Tree, so the training loop never blocks
+    on a device→host fetch. Any Tree attribute access (num_leaves,
+    to_string, leaf_index_raw, ...) transparently materializes the host
+    Tree once and delegates to it, so consumers that read GBDT.models
+    directly keep working without an explicit materialize pass."""
 
     def __init__(self, grower: FusedSerialGrower, tree_arrays: Dict) -> None:
+        self._tree: Optional[Tree] = None
         self.grower = grower
         self.tree_arrays = tree_arrays
         self.pending_shrinkage = 1.0
         self.pending_bias = 0.0
 
     def apply_shrinkage(self, rate: float) -> None:
-        self.pending_shrinkage *= rate
+        if self._tree is not None:
+            self._tree.apply_shrinkage(rate)
+        else:
+            self.pending_shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
-        self.pending_bias += val
+        if self._tree is not None:
+            self._tree.add_bias(val)
+        else:
+            self.pending_bias += val
 
     def leaf_values_device(self):
+        if self._tree is not None:
+            return self._tree.leaf_values_device()
         return (self.tree_arrays["leaf_value"] * self.pending_shrinkage
                 + self.pending_bias)
 
     def materialize(self) -> Tree:
-        tree = self.grower.materialize_tree(self.tree_arrays)
-        if self.pending_shrinkage != 1.0:
-            tree.apply_shrinkage(self.pending_shrinkage)
-        if self.pending_bias != 0.0:
-            tree.add_bias(self.pending_bias)
-        return tree
+        if self._tree is None:
+            tree = self.grower.materialize_tree(self.tree_arrays)
+            if self.pending_shrinkage != 1.0:
+                tree.apply_shrinkage(self.pending_shrinkage)
+            if self.pending_bias != 0.0:
+                tree.add_bias(self.pending_bias)
+            self._tree = tree
+        return self._tree
+
+    def __getattr__(self, name: str):
+        # only reached when normal lookup fails → a Tree attribute;
+        # materialize once and delegate. Guard against recursion during
+        # unpickling/copy before __init__ has run.
+        if name.startswith("__") or name in ("_tree", "grower", "tree_arrays",
+                                             "pending_shrinkage",
+                                             "pending_bias"):
+            raise AttributeError(name)
+        return getattr(self.materialize(), name)
