@@ -12,14 +12,13 @@ partitioning consume it as dense arrays.  Bin finding itself
 reference (bin_construct_sample_cnt, dataset_loader.cpp:527
 ConstructFromSampleData).
 
-Exclusive Feature Bundling note: the reference bundles sparse mutually-
-exclusive features into shared columns to cut histogram work
-(dataset.cpp:50-302 GetConflictCount/FindGroups/FastFeatureBundling).  On TPU
-the same memory/bandwidth win is achieved by the packed integer matrix plus
-the MXU one-hot histogram (no per-feature column walk), so bundling is a
-pure storage optimization here; sparse inputs are densified at bin-code level
-(bin codes of absent entries are the feature's zero/default bin, matching
-reference semantics).
+Exclusive Feature Bundling: sparse near-mutually-exclusive features are
+packed into shared uint8 bundle columns (io/efb.py; reference
+dataset.cpp:50-302 GetConflictCount/FindGroups/FastFeatureBundling), so
+the HBM matrix is [N, num_groups] with num_groups << num_features on
+sparse data, and every histogram pass touches only the bundled columns.
+scipy CSR/CSC inputs are consumed without densifying the raw floats —
+only the bundled bin-code matrix is ever materialized.
 """
 from __future__ import annotations
 
@@ -32,6 +31,15 @@ from ..config import Config
 from ..utils import log
 from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, K_ZERO_THRESHOLD,
                       MISSING_NAN, MISSING_NONE, MISSING_ZERO, BinMapper)
+from .efb import BundleTables, build_bundles
+
+
+def _is_sparse(data) -> bool:
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(data)
+    except ImportError:
+        return False
 
 
 class Metadata:
@@ -155,9 +163,14 @@ class BinnedDataset:
         ``reference`` aligns bin mappers with a previously-constructed dataset
         (validation data; reference Dataset::CreateValid, dataset.cpp).
         """
-        data = np.asarray(data)
-        if data.ndim != 2:
-            log.fatal("Data must be 2-dimensional")
+        sparse_input = _is_sparse(data)
+        if sparse_input:
+            import scipy.sparse as sp
+            data = data.tocsc() if not sp.isspmatrix_csc(data) else data
+        else:
+            data = np.asarray(data)
+            if data.ndim != 2:
+                log.fatal("Data must be 2-dimensional")
         n, total_features = data.shape
         ds = cls()
         ds.num_data = n

@@ -180,26 +180,26 @@ class FusedSerialGrower:
 
     def _partition_full(self, perm, start, count, feature, thr, dl, miss_bin,
                         grad_dummy=None):
-        """Stable in-window partition by masked cumsum over the whole
-        permutation (replaces data_partition.hpp's threaded two-way
-        partition; O(N), no sort)."""
-        n = perm.shape[0]
-        pos = jnp.arange(n, dtype=jnp.int32)
-        in_win = (pos >= start) & (pos < start + count)
-        b = self.bins[perm, feature].astype(jnp.int32)
-        go_left = b <= thr
-        is_miss = (b == miss_bin) & (miss_bin >= 0)
-        go_left = jnp.where(is_miss, dl, go_left)
-        gl = go_left & in_win
-        gr = (~go_left) & in_win
-        nleft = jnp.sum(gl).astype(jnp.int32)
-        left_rank = jnp.cumsum(gl) - 1
-        right_rank = jnp.cumsum(gr) - 1
-        new_pos = jnp.where(
-            gl, start + left_rank,
-            jnp.where(gr, start + nleft + right_rank, pos)).astype(jnp.int32)
-        new_perm = jnp.zeros_like(perm).at[new_pos].set(perm, unique_indices=True)
-        return new_perm, nleft
+        """Stable partition of one leaf's window, O(capacity) per split
+        (replaces data_partition.hpp's threaded two-way partition).
+        lax.switch over power-of-two capacity buckets keeps the work
+        proportional to the leaf size under static shapes — an O(N)
+        full-permutation variant costs ~80% of tree time at 1M rows."""
+        from ..ops.partition import partition_leaf
+
+        def branch(cap):
+            def fn(perm, start, count, feature, thr, dl, miss_bin):
+                return partition_leaf(self.bins, perm, start, count, feature,
+                                      thr, dl, miss_bin, jnp.bool_(False),
+                                      jnp.zeros(1, jnp.uint32), cap)
+            return fn
+
+        branches = [branch(c) for c in self._caps]
+        cap_arr = jnp.asarray(self._caps, jnp.int32)
+        idx = jnp.searchsorted(cap_arr, jnp.maximum(count, 1))
+        idx = jnp.minimum(idx, len(self._caps) - 1)
+        return jax.lax.switch(idx, branches, perm, start, count, feature,
+                              thr, dl, miss_bin)
 
     def _scan_leaf(self, hist, sum_g, sum_h, count, output, cmin, cmax,
                    feature_mask):

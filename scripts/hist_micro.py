@@ -1,0 +1,43 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops import histogram as H
+
+N, F, B = 1_000_000, 28, 256
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, B, size=(N, F), dtype=np.int32).astype(np.uint8))
+grad = jnp.asarray(rng.randn(N).astype(np.float32))
+hess = jnp.asarray(np.ones(N, np.float32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+for cap in [4096, 16384, 65536, 262144, 1048576]:
+    @jax.jit
+    def chained(perm, s):
+        acc = jnp.float32(0)
+        for i in range(10):
+            h = H.leaf_histogram(bins, perm, s + i, jnp.int32(cap * 3 // 4),
+                                 grad, hess, cap, B)
+            acc = acc + h[0, 0, 0]   # data dep prevents elimination
+            s = s + (acc > 1e30).astype(jnp.int32)  # keep deps serial
+        return acc
+    out = chained(perm, jnp.int32(1)); jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(5): out = chained(perm, jnp.int32(1))
+    jax.block_until_ready(out)
+    per = (time.time() - t0) / 5 / 10 * 1e3
+    print(f"cap={cap}: {per:.3f} ms per leaf_histogram", flush=True)
+
+# also: the gather alone
+for cap in [65536, 1048576]:
+    @jax.jit
+    def gonly(perm, s):
+        acc = jnp.float32(0)
+        for i in range(10):
+            rows, valid = H.gather_leaf_rows(perm, s + i, jnp.int32(cap * 3 // 4), cap)
+            b = bins[rows]
+            acc = acc + b[0, 0] + jnp.sum(valid[:1])
+            s = s + (acc > 1e30).astype(jnp.int32)
+        return acc
+    out = gonly(perm, jnp.int32(1)); jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(5): out = gonly(perm, jnp.int32(1))
+    jax.block_until_ready(out)
+    print(f"gather-only cap={cap}: {(time.time()-t0)/50*1e3:.3f} ms", flush=True)
