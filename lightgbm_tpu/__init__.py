@@ -16,6 +16,8 @@ __version__ = "0.1.0"
 from .config import Config
 from .utils import log
 from .basic import Booster, Dataset, LightGBMError
+from .callback import (early_stopping, print_evaluation, record_evaluation,
+                       reset_parameter)
 from .engine import CVBooster, cv, train
 
 __all__ = [
@@ -26,6 +28,10 @@ __all__ = [
     "train",
     "cv",
     "CVBooster",
+    "early_stopping",
+    "print_evaluation",
+    "record_evaluation",
+    "reset_parameter",
     "__version__",
 ]
 

@@ -17,16 +17,16 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .config import Config
-from .io.dataset import BinnedDataset
+from .io.dataset import BinnedDataset, _is_sparse
 from .utils import log
 from .utils.log import LightGBMError
 
 
-def _to_2d_numpy(data) -> np.ndarray:
+def _to_2d_numpy(data):
     if hasattr(data, "values") and hasattr(data, "dtypes"):  # DataFrame
         return _pandas_to_numpy(data)
-    if hasattr(data, "toarray"):  # scipy sparse
-        return np.asarray(data.toarray(), dtype=np.float64)
+    if _is_sparse(data):  # consumed column-wise without densifying
+        return data
     arr = np.asarray(data)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -94,7 +94,8 @@ class Dataset:
             return self
         mat = _to_2d_numpy(self.data)
         if self.used_indices is not None:
-            mat = mat[self.used_indices]
+            mat = mat.tocsr()[self.used_indices] if _is_sparse(mat) \
+                else mat[self.used_indices]
         cfg = Config.from_params(self.params)
         feature_names = self._resolve_feature_names(mat.shape[1])
         cat = self._resolve_categorical(feature_names)
@@ -259,6 +260,7 @@ class Dataset:
         h.inner_feature_index = parent.inner_feature_index
         h.feature_names = parent.feature_names
         h.max_bin = parent.max_bin
+        h.bundles = parent.bundles
         from .io.dataset import Metadata
         h.metadata = Metadata(len(idx))
         if parent.metadata.label is not None:
@@ -285,10 +287,12 @@ class Dataset:
         a, b = self._handle, other._handle
         if a.num_data != b.num_data:
             raise LightGBMError("Cannot add features from a different-size dataset")
+        abins, bbins = a.feature_bins(), b.feature_bins()
+        a.bundles = None
         a.bins = np.concatenate(
-            [a.bins, b.bins.astype(a.bins.dtype, copy=False)], axis=1) \
-            if a.bins.dtype == b.bins.dtype else np.concatenate(
-                [a.bins.astype(np.uint16), b.bins.astype(np.uint16)], axis=1)
+            [abins, bbins.astype(abins.dtype, copy=False)], axis=1) \
+            if abins.dtype == bbins.dtype else np.concatenate(
+                [abins.astype(np.uint16), bbins.astype(np.uint16)], axis=1)
         a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
         offset = a.num_total_features
         a.real_feature_index = list(a.real_feature_index) + \
@@ -326,6 +330,11 @@ class Booster:
                 raise TypeError(f"Training data should be Dataset instance, "
                                 f"met {type(train_set).__name__}")
             cfg = Config.from_params(self.params)
+            if train_set._handle is None:
+                # dataset-level params given at train() time shape the
+                # construction (max_bin, enable_bundle, ...) — reference
+                # Dataset._update_params semantics: later params win
+                train_set.params = {**(train_set.params or {}), **self.params}
             train_set.construct()
             self._train_set = train_set
             objective = create_objective(cfg)
@@ -488,6 +497,27 @@ class Booster:
         mat = _to_2d_numpy(data)
         if num_iteration is None:
             num_iteration = -1
+        if _is_sparse(mat):
+            # inference traverses raw feature values; densify sparse
+            # inputs in bounded row chunks (reference predicts CSR rows
+            # one at a time through the same raw-value decision path)
+            csr = mat.tocsr()
+            n = csr.shape[0]
+            chunk = max(1024, min(max(n, 1), 1 << 16))
+            parts = [self._predict_dense(
+                np.asarray(csr[i:i + chunk].todense(), dtype=np.float64),
+                start_iteration, num_iteration, raw_score, pred_leaf,
+                pred_contrib) for i in range(0, n, chunk)]
+            if not parts:
+                return self._predict_dense(
+                    np.zeros((0, csr.shape[1])), start_iteration,
+                    num_iteration, raw_score, pred_leaf, pred_contrib)
+            return np.concatenate(parts, axis=0)
+        return self._predict_dense(mat, start_iteration, num_iteration,
+                                   raw_score, pred_leaf, pred_contrib)
+
+    def _predict_dense(self, mat, start_iteration, num_iteration,
+                       raw_score, pred_leaf, pred_contrib) -> np.ndarray:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(mat, start_iteration, num_iteration)
         if pred_contrib:
@@ -500,7 +530,7 @@ class Booster:
         """reference basic.py:2873 Booster.refit."""
         mat = _to_2d_numpy(data)
         self._gbdt._materialize_models()
-        leaf = self._gbdt.predict_leaf_index(mat, 0, -1)
+        leaf = self.predict(data, pred_leaf=True)
         new_params = dict(self.params)
         new_params["refit_decay_rate"] = decay_rate
         train = Dataset(mat, label=label, params=new_params,

@@ -53,7 +53,8 @@ class _ScoreState:
         self.score = self.score.at[class_id].add(jnp.float32(val))
 
     def add_tree(self, tree: Tree, class_id: int, miss_bin_map: np.ndarray) -> None:
-        leaf_idx = tree.leaf_index_binned(self.dataset.device_bins(), miss_bin_map)
+        leaf_idx = tree.leaf_index_binned(self.dataset.device_bins(), miss_bin_map,
+                                          efb=self.dataset.device_bundle_tables())
         vals = tree.leaf_values_device()
         self.score = self.score.at[class_id].add(vals[leaf_idx])
 
@@ -76,6 +77,7 @@ class GBDT:
         self.loaded_parameter = ""
         self.feature_names_: List[str] = []
         self.label_idx = 0
+        self._convert_jit = None  # jitted objective.convert_output
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: BinnedDataset,
@@ -125,6 +127,12 @@ class GBDT:
                 return SerialTreeGrower(train_data, config)
             if config.tree_learner == "serial":
                 return SerialTreeGrower(train_data, config)
+            if not train_data.efb_trivial:
+                # parallel learners shard the bin matrix by feature;
+                # decode bundles back to per-feature columns for them
+                log.warning("EFB bundles are not yet supported by parallel "
+                            "tree learners; debundling the dataset")
+                train_data.debundle()
             from ..treelearner.parallel import create_parallel_learner
             return create_parallel_learner(config.tree_learner, train_data, config)
         log.fatal("Unknown tree learner type %s", config.tree_learner)
@@ -208,6 +216,8 @@ class GBDT:
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference GBDT::TrainOneIter,
         gbdt.cpp:337). Returns True when training should stop."""
+        # any model mutation invalidates the packed prediction forest
+        self._pred_revision = getattr(self, "_pred_revision", 0) + 1
         k = self.num_tree_per_iteration
         init_scores = [0.0] * k
         if gradients is None or hessians is None:
@@ -349,7 +359,8 @@ class GBDT:
             return
         miss = self.tree_learner.feature_miss_bin
         leaf_idx = np.asarray(tree.leaf_index_binned(
-            self.train_data.device_bins(), miss))
+            self.train_data.device_bins(), miss,
+            efb=self.train_data.device_bundle_tables()))
         score = np.asarray(self.train_score.score[class_id])
         label = np.asarray(self.train_data.metadata.label)
         residual = label - score
@@ -406,40 +417,92 @@ class GBDT:
             end = total
         return self.models[start * k:end * k]
 
+    def _packed_forest(self, start_iteration: int, num_iteration: int):
+        """Cached PackedForest over the selected tree range (reference
+        SingleRowPredictor caches its Predictor the same way)."""
+        from ..models.forest import PackedForest
+        models = self._used_models(start_iteration, num_iteration)
+        key = (start_iteration, num_iteration, len(self.models),
+               getattr(self, "_pred_revision", 0))
+        cache = getattr(self, "_forest_cache", None)
+        if cache is None or cache[0] != key:
+            forest = PackedForest(models, self.num_tree_per_iteration)
+            self._forest_cache = (key, forest)
+        return self._forest_cache[1], models
+
+    @staticmethod
+    def _pad_rows(x: np.ndarray):
+        """Pad the batch to a power-of-two bucket (>=8) so the jitted
+        forest kernels specialize on O(log N) batch shapes — this is
+        the single-row fast path: a 1-row predict reuses the 8-row
+        program from the jit cache."""
+        n = x.shape[0]
+        cap = 8
+        while cap < n:
+            cap *= 2
+        if cap == n:
+            return x, n
+        return np.pad(x, ((0, cap - n), (0, 0))), n
+
+    def _raw_scores_device(self, x: np.ndarray, start_iteration: int,
+                           num_iteration: int):
+        """Device-resident [k, cap] raw scores + (n, had_models). The
+        whole path is one host→device upload and one program — every
+        extra transfer costs a full tunnel round trip on remote
+        accelerators, so conversion/averaging stay device-side too."""
+        forest, models = self._packed_forest(start_iteration, num_iteration)
+        k = self.num_tree_per_iteration
+        n_in = np.asarray(x).shape[0]
+        if not models:
+            return None, n_in
+        xp, n = self._pad_rows(np.asarray(x, dtype=np.float32))
+        xd = jnp.asarray(xp)
+        cfg = self.config
+        if cfg is not None and cfg.pred_early_stop:
+            score = forest.raw_scores_early_stop(
+                xd, max(1, cfg.pred_early_stop_freq),
+                float(cfg.pred_early_stop_margin))
+        else:
+            score = forest.raw_scores(xd)
+        if self.average_output:
+            score = score / (len(models) // k)
+        return score, n
+
     def predict_raw(self, x: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
-        """Raw scores [N] or [N, num_class]."""
-        x = jnp.asarray(np.asarray(x, dtype=np.float32))
-        n = x.shape[0]
+        """Raw scores [N] or [N, num_class] — one device dispatch via
+        the packed forest (replacing one dispatch per tree)."""
         k = self.num_tree_per_iteration
-        score = jnp.zeros((k, n), dtype=jnp.float32)
-        models = self._used_models(start_iteration, num_iteration)
-        for i, tree in enumerate(models):
-            c = i % k
-            leaf = tree.leaf_index_raw(x)
-            score = score.at[c].add(tree.leaf_values_device()[leaf])
-        out = np.asarray(score, dtype=np.float64)
-        if self.average_output and models:
-            out /= len(models) // k
+        score, n = self._raw_scores_device(x, start_iteration, num_iteration)
+        if score is None:
+            out = np.zeros((k, n), dtype=np.float64)
+            return out[0] if k == 1 else out.T
+        out = np.asarray(score, dtype=np.float64)[:, :n]
         return out[0] if k == 1 else out.T
 
     def predict(self, x: np.ndarray, start_iteration: int = 0,
                 num_iteration: int = -1) -> np.ndarray:
-        raw = self.predict_raw(x, start_iteration, num_iteration)
-        if self.objective is not None:
-            conv = self.objective.convert_output(jnp.asarray(raw))
-            out = np.asarray(conv, dtype=np.float64)
-            return out
-        return raw
+        k = self.num_tree_per_iteration
+        score, n = self._raw_scores_device(x, start_iteration, num_iteration)
+        if score is None:
+            out = np.zeros((k, n), dtype=np.float64)
+        elif self.objective is not None:
+            if self._convert_jit is None:
+                conv = self.objective.convert_output
+                self._convert_jit = jax.jit(lambda s: conv(s))
+            out = np.asarray(self._convert_jit(score.T), dtype=np.float64).T
+        else:
+            out = np.asarray(score, dtype=np.float64)
+        out = out[:, :n]
+        return out[0] if k == 1 else out.T
 
     def predict_leaf_index(self, x: np.ndarray, start_iteration: int = 0,
                            num_iteration: int = -1) -> np.ndarray:
-        x = jnp.asarray(np.asarray(x, dtype=np.float32))
-        models = self._used_models(start_iteration, num_iteration)
-        out = np.empty((x.shape[0], len(models)), dtype=np.int32)
-        for i, tree in enumerate(models):
-            out[:, i] = np.asarray(tree.leaf_index_raw(x))
-        return out
+        forest, models = self._packed_forest(start_iteration, num_iteration)
+        if not models:
+            return np.empty((np.asarray(x).shape[0], 0), dtype=np.int32)
+        xp, n = self._pad_rows(np.asarray(x, dtype=np.float32))
+        return np.asarray(forest.leaf_indices(jnp.asarray(xp)))[:n]
 
     def predict_contrib(self, x: np.ndarray, start_iteration: int = 0,
                         num_iteration: int = -1) -> np.ndarray:
@@ -600,6 +663,7 @@ class GBDT:
         of the existing structure with new gradients."""
         from ..ops.split import threshold_l1
         cfg = self.config
+        self._pred_revision = getattr(self, "_pred_revision", 0) + 1
         leaf_pred = np.asarray(tree_leaf_prediction, dtype=np.int64)
         self._materialize_models()
         self._boosting()

@@ -1,164 +1,223 @@
-"""Training callbacks (reference: python-package/lightgbm/callback.py).
+"""Training callbacks.
 
-Same callback environment contract: each callback receives a
-``CallbackEnv`` namedtuple; ``early_stopping`` raises
-``EarlyStopException`` carrying the best iteration + scores.
+Keeps the reference package's callback *contract* (reference:
+python-package/lightgbm/callback.py — factories returning callables
+with ``order``/``before_iteration`` attributes, invoked with a
+``CallbackEnv``; ``early_stopping`` signals via ``EarlyStopException``)
+but is built differently: each callback is a small class whose
+instances are callable, holding their state as attributes instead of
+closure cells.
+
+Evaluation entries are tuples ``(dataset_name, metric_name, value,
+is_higher_better[, stdv])`` — the 4/5-tuple shape the engine and cv
+loops produce.
 """
 from __future__ import annotations
 
-import collections
-from typing import Callable, List
+from collections import OrderedDict, namedtuple
+from typing import Callable, Dict, List, Optional
 
 from .utils import log
 
 
 class EarlyStopException(Exception):
+    """Raised by early_stopping to unwind the training loop."""
+
     def __init__(self, best_iteration: int, best_score) -> None:
         super().__init__()
         self.best_iteration = best_iteration
         self.best_score = best_score
 
 
-CallbackEnv = collections.namedtuple(
+CallbackEnv = namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
      "evaluation_result_list"])
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    if len(value) == 5:
-        if show_stdv:
-            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    raise ValueError("Wrong metric value")
+def _entry_text(entry, with_stdv: bool = True) -> str:
+    """'<dataset>'s <metric>: <value>[ + <stdv>]' for a 4/5-tuple."""
+    if len(entry) not in (4, 5):
+        raise ValueError("Wrong metric value")
+    head = f"{entry[0]}'s {entry[1]}: {entry[2]:g}"
+    if len(entry) == 5 and with_stdv:
+        head += f" + {entry[4]:g}"
+    return head
+
+
+def _joined(entries, with_stdv: bool = True) -> str:
+    return "\t".join(_entry_text(e, with_stdv) for e in entries)
+
+
+class _EvalLogger:
+    """Periodic metric printer."""
+
+    order = 10
+
+    def __init__(self, period: int, show_stdv: bool) -> None:
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % self.period:
+            return
+        log.info("[%d]\t%s", env.iteration + 1,
+                 _joined(env.evaluation_result_list, self.show_stdv))
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list \
-                and (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            log.info("[%d]\t%s", env.iteration + 1, result)
-    _callback.order = 10
-    return _callback
+    return _EvalLogger(period, show_stdv)
 
 
 log_evaluation = print_evaluation
 
 
+class _EvalRecorder:
+    """Appends every evaluation into a user-owned nested dict:
+    result[dataset_name][metric_name] -> list of values per iteration."""
+
+    order = 20
+
+    def __init__(self, store: dict) -> None:
+        self.store = store
+        self._started = False
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if not self._started:
+            self.store.clear()
+            self._started = True
+        for entry in env.evaluation_result_list:
+            series = self.store.setdefault(entry[0], OrderedDict())
+            series.setdefault(entry[1], []).append(entry[2])
+
+
 def record_evaluation(eval_result: dict) -> Callable:
     if not isinstance(eval_result, dict):
         raise TypeError("eval_result should be a dictionary")
+    return _EvalRecorder(eval_result)
 
-    def _init(env: CallbackEnv) -> None:
-        eval_result.clear()
-        for item in env.evaluation_result_list:
-            name, metric = item[0], item[1]
-            eval_result.setdefault(name, collections.OrderedDict())
-            eval_result[name].setdefault(metric, [])
 
-    def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for item in env.evaluation_result_list:
-            name, metric, value = item[0], item[1], item[2]
-            eval_result.setdefault(name, collections.OrderedDict())
-            eval_result[name].setdefault(metric, [])
-            eval_result[name][metric].append(value)
-    _callback.order = 20
-    return _callback
+class _ParamScheduler:
+    """Re-applies parameters each iteration from per-key schedules
+    (a list indexed by round, or a callable of the round index)."""
+
+    order = 10
+    before_iteration = True
+
+    def __init__(self, schedules: Dict) -> None:
+        self.schedules = schedules
+
+    def _value_at(self, key: str, spec, round_idx: int, total: int):
+        if isinstance(spec, list):
+            if len(spec) != total:
+                raise ValueError(f"Length of list {key!r} has to equal to "
+                                 "'num_boost_round'")
+            return spec[round_idx]
+        if callable(spec):
+            return spec(round_idx)
+        raise ValueError("Only list and callable values are supported "
+                         "as a mapping from boosting round index to new "
+                         "parameter value")
+
+    def __call__(self, env: CallbackEnv) -> None:
+        round_idx = env.iteration - env.begin_iteration
+        total = env.end_iteration - env.begin_iteration
+        updates = {k: self._value_at(k, v, round_idx, total)
+                   for k, v in self.schedules.items()}
+        if not updates:
+            return
+        if "learning_rate" in updates:
+            env.model._gbdt.shrinkage_rate = float(updates["learning_rate"])
+        env.model.params.update(updates)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(f"Length of list {key!r} has to equal to "
-                                     "'num_boost_round'")
-                new_param = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_param = value(env.iteration - env.begin_iteration)
-            else:
-                raise ValueError("Only list and callable values are supported "
-                                 "as a mapping from boosting round index to new parameter value")
-            new_parameters[key] = new_param
-        if new_parameters:
-            if "learning_rate" in new_parameters:
-                env.model._gbdt.shrinkage_rate = float(new_parameters["learning_rate"])
-            env.model.params.update(new_parameters)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    return _ParamScheduler(kwargs)
 
 
-def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
-                   verbose: bool = True) -> Callable:
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
+class _MetricState:
+    """Best-so-far tracker for one (dataset, metric) series."""
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(env.params.get(alias, "") == "dart"
-                             for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    __slots__ = ("best_value", "best_round", "best_entries", "higher_better")
+
+    def __init__(self, higher_better: bool) -> None:
+        self.higher_better = higher_better
+        self.best_value = float("-inf") if higher_better else float("inf")
+        self.best_round = 0
+        self.best_entries = None
+
+    def improved(self, value: float) -> bool:
+        return value > self.best_value if self.higher_better \
+            else value < self.best_value
+
+
+class _EarlyStopper:
+    """Stops when no tracked validation metric improved for
+    ``stopping_rounds`` consecutive rounds."""
+
+    order = 30
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool) -> None:
+        self.stopping_rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.states: List[_MetricState] = []
+        self.active = True
+        self.first_metric = ""
+
+    # -- setup on first call -------------------------------------------
+    def _setup(self, env: CallbackEnv) -> None:
+        boosting = [env.params.get(k, "") for k in
+                    ("boosting", "boosting_type", "boost")]
+        if "dart" in boosting:
+            self.active = False
             log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
             raise ValueError("For early stopping, at least one dataset and "
                              "eval metric is required for evaluation")
-        if verbose:
-            log.info("Training until validation scores don't improve for %d rounds",
-                     stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
-        for item in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if item[3]:  # bigger is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y)
+        if self.verbose:
+            log.info("Training until validation scores don't improve for "
+                     "%d rounds", self.stopping_rounds)
+        self.first_metric = self._metric_key(env.evaluation_result_list[0])
+        self.states = [_MetricState(bool(e[3]))
+                       for e in env.evaluation_result_list]
 
-    def _final_iteration_check(env, eval_name_splitted, i) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if verbose:
-                log.info("Did not meet early stopping. Best iteration is:\n[%d]\t%s",
-                         best_iter[i] + 1,
-                         "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-            raise EarlyStopException(best_iter[i], best_score_list[i])
+    @staticmethod
+    def _metric_key(entry) -> str:
+        return entry[1].split(" ")[-1]
 
-    def _callback(env: CallbackEnv) -> None:
-        if not best_score:
-            _init(env)
-        if not enabled[0]:
+    def _announce_and_stop(self, state: _MetricState, reason: str) -> None:
+        if self.verbose:
+            log.info("%s, best iteration is:\n[%d]\t%s", reason,
+                     state.best_round + 1, _joined(state.best_entries))
+        raise EarlyStopException(state.best_round, state.best_entries)
+
+    # -- per-iteration --------------------------------------------------
+    def __call__(self, env: CallbackEnv) -> None:
+        if not self.states and self.active:
+            self._setup(env)
+        if not self.active:
             return
-        for i, item in enumerate(env.evaluation_result_list):
-            score = item[2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            eval_name_splitted = item[1].split(" ")
-            if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
+        is_last = env.iteration == env.end_iteration - 1
+        for state, entry in zip(self.states, env.evaluation_result_list):
+            if state.best_entries is None or state.improved(entry[2]):
+                state.best_value = entry[2]
+                state.best_round = env.iteration
+                state.best_entries = env.evaluation_result_list
+            if self.first_metric_only \
+                    and self._metric_key(entry) != self.first_metric:
                 continue
-            if item[0] == "training":
-                _final_iteration_check(env, eval_name_splitted, i)
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            _final_iteration_check(env, eval_name_splitted, i)
-    _callback.order = 30
-    return _callback
+            if entry[0] != "training" \
+                    and env.iteration - state.best_round >= self.stopping_rounds:
+                self._announce_and_stop(state, "Early stopping")
+            if is_last:
+                self._announce_and_stop(state, "Did not meet early stopping")
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    return _EarlyStopper(stopping_rounds, first_metric_only, verbose)

@@ -42,6 +42,14 @@ def _is_sparse(data) -> bool:
         return False
 
 
+def _csc_col(data, f: int):
+    """(row_indices, values) of column ``f`` of a CSC matrix — the only
+    sparse access pattern the data plane needs (reference sparse_bin.hpp
+    iterates per-feature nonzeros the same way)."""
+    start, end = data.indptr[f], data.indptr[f + 1]
+    return data.indices[start:end], data.data[start:end]
+
+
 class Metadata:
     """Per-row training metadata (reference: src/io/metadata.cpp,
     include/LightGBM/dataset.h:40-248): label, weights, query boundaries,
@@ -109,13 +117,14 @@ class BinnedDataset:
     def __init__(self) -> None:
         self.num_data: int = 0
         self.num_total_features: int = 0
-        self.bins: Optional[np.ndarray] = None  # [N, F_used]
+        self.bins: Optional[np.ndarray] = None  # [N, G] group bin codes
         self.bin_mappers: List[BinMapper] = []
         self.real_feature_index: List[int] = []  # used idx -> original idx
         self.inner_feature_index: Dict[int, int] = {}  # original -> used or absent
         self.feature_names: List[str] = []
         self.metadata: Metadata = Metadata(0)
         self.max_bin: int = 255
+        self.bundles: Optional[BundleTables] = None  # None == identity
         self._device_bins = None
         self._monotone_constraints: List[int] = []
 
@@ -144,6 +153,65 @@ class BinnedDataset:
         if self._device_bins is None:
             self._device_bins = jnp.asarray(self.bins)
         return self._device_bins
+
+    # --- EFB views --------------------------------------------------------
+    @property
+    def efb_trivial(self) -> bool:
+        return self.bundles is None or self.bundles.is_trivial
+
+    @property
+    def group_max_bins(self) -> int:
+        """Max bin-code count over the physical bundle columns (== max
+        feature num_bin when bundling is trivial)."""
+        if self.efb_trivial:
+            return self.max_num_bin
+        return int(self.bundles.group_num_bins.max())
+
+    def device_bundle_tables(self):
+        """(group_of, offset_of, nslots_of, skip_of) device arrays, or
+        None when bundling is trivial (consumers then index features
+        directly — zero overhead on dense data)."""
+        if self.efb_trivial:
+            return None
+        return self.bundles.device()
+
+    def device_hist_tables(self):
+        """Gather tables for bundle-hist → per-feature-hist conversion."""
+        if self.efb_trivial:
+            return None
+        return self.bundles.hist_tables(
+            [m.num_bin for m in self.bin_mappers], self.max_num_bin)
+
+    def feature_bins(self) -> np.ndarray:
+        """Decoded per-feature bin matrix [N, F_used] (host). Identity
+        when bundling is trivial; otherwise materializes the dense view —
+        used only by consumers that cannot work in bundle space
+        (add_features_from, parallel-learner debundling)."""
+        if self.efb_trivial:
+            return self.bins
+        bt = self.bundles
+        f_used = len(self.bin_mappers)
+        dtype = np.uint8 if all(m.num_bin <= 256 for m in self.bin_mappers) \
+            else np.uint16
+        out = np.empty((self.num_data, f_used), dtype=dtype)
+        for f in range(f_used):
+            codes = self.bins[:, bt.group_of[f]].astype(np.int32)
+            rel = codes - bt.offset_of[f]
+            inband = (rel >= 0) & (rel < bt.nslots_of[f])
+            dec = rel + (rel >= bt.skip_of[f])
+            out[:, f] = np.where(inband, dec, bt.skip_of[f]).astype(dtype)
+        return out
+
+    def debundle(self) -> None:
+        """Replace the bundled bin matrix with the per-feature view
+        (consumers that shard by feature — parallel learners — keep their
+        simple layout; the reference supports EFB there via FeatureGroup
+        indirection, which is a later-round TPU design)."""
+        if self.efb_trivial:
+            return
+        self.bins = self.feature_bins()
+        self.bundles = None
+        self._device_bins = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -187,13 +255,16 @@ class BinnedDataset:
         ds.feature_names = list(feature_names)
 
         if reference is not None:
-            # validation set: reuse the reference's mappers
+            # validation set: reuse the reference's mappers AND bundles
+            # (scores are updated by bin-space traversal, which decodes
+            # through the training set's bundle tables)
             ds.bin_mappers = reference.bin_mappers
             ds.real_feature_index = reference.real_feature_index
             ds.inner_feature_index = reference.inner_feature_index
             ds.feature_names = reference.feature_names
             ds.max_bin = reference.max_bin
             ds._monotone_constraints = reference._monotone_constraints
+            ds.bundles = reference.bundles
             ds._apply_mappers(data)
             return ds
 
@@ -207,22 +278,33 @@ class BinnedDataset:
         rng = np.random.RandomState(config.data_random_seed)
         if sample_cnt < n:
             sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
-            sample = data[sample_idx]
+            sample = data.tocsr()[sample_idx].tocsc() if sparse_input \
+                else data[sample_idx]
         else:
             sample = data
-        sample = np.asarray(sample, dtype=np.float64)
+        if not sparse_input:
+            sample = np.asarray(sample, dtype=np.float64)
+
+        def sample_col_nonzeros(f):
+            """(row_indices, values) of the sample column's stored
+            entries — full column for dense input."""
+            if sparse_input:
+                idx, vals = _csc_col(sample, f)
+                return idx, np.asarray(vals, dtype=np.float64)
+            col = sample[:, f]
+            return np.arange(sample_cnt), col
 
         # --- per-feature bin finding ---
         mappers: List[BinMapper] = []
         for f in range(total_features):
-            col = sample[:, f]
+            _, col = sample_col_nonzeros(f)
             nonzero = col[(np.abs(col) > K_ZERO_THRESHOLD) | np.isnan(col)]
             m = BinMapper()
             if config.max_bin_by_feature and f < len(config.max_bin_by_feature):
                 mb = config.max_bin_by_feature[f]
             else:
                 mb = config.max_bin
-            m.find_bin(nonzero, len(col), mb,
+            m.find_bin(nonzero, sample_cnt, mb,
                        min_data_in_bin=config.min_data_in_bin,
                        min_split_data=config.min_data_in_leaf,
                        pre_filter=config.feature_pre_filter,
@@ -241,17 +323,92 @@ class BinnedDataset:
             ds._monotone_constraints = [
                 config.monotone_constraints[f] if f < len(config.monotone_constraints) else 0
                 for f in used]
+
+        # --- EFB bundling decision over the sample (dataset.cpp:50-302) ---
+        if config.enable_bundle and len(used) > 1:
+            from .efb import bundle_eligible
+            nonzero_rows: List[np.ndarray] = []
+            bundle_ok: List[bool] = []
+            empty = np.empty(0, dtype=np.int64)
+            for i, f in enumerate(used):
+                m = ds.bin_mappers[i]
+                ok = bundle_eligible(m) and m.sparse_rate >= 0.5
+                bundle_ok.append(ok)
+                if not ok:
+                    nonzero_rows.append(empty)
+                    continue
+                idx, vals = sample_col_nonzeros(f)
+                b = m.values_to_bins(vals)
+                nonzero_rows.append(np.asarray(idx)[b != m.most_freq_bin])
+            ds.bundles = build_bundles(nonzero_rows, ds.bin_mappers,
+                                       sample_cnt, True, bundle_ok=bundle_ok)
+            if ds.bundles.is_trivial:
+                ds.bundles = None
         ds._apply_mappers(data)
         return ds
 
     def _apply_mappers(self, data: np.ndarray) -> None:
+        """Push every row through the mappers into the packed bin-code
+        matrix: [N, F_used] per-feature codes when bundling is trivial,
+        [N, num_groups] bundle codes otherwise (reference
+        FeatureGroup::PushData / Bin::Push; sparse inputs touch only
+        their stored entries — never densified)."""
         n = data.shape[0]
-        f_used = len(self.bin_mappers)
-        dtype = np.uint8 if all(m.num_bin <= 256 for m in self.bin_mappers) else np.uint16
-        bins = np.empty((n, f_used), dtype=dtype)
-        for i, f in enumerate(self.real_feature_index):
-            col = np.asarray(data[:, f], dtype=np.float64)  # one column at a time
-            bins[:, i] = self.bin_mappers[i].values_to_bins(col).astype(dtype)
+        sparse = _is_sparse(data)
+        mappers = self.bin_mappers
+        bt = self.bundles
+
+        def col_bins(i: int):
+            """(row_indices_or_None, codes) for used feature i; None row
+            indices mean 'all rows, in order'."""
+            f = self.real_feature_index[i]
+            if sparse:
+                idx, vals = _csc_col(data, f)
+                return idx, mappers[i].values_to_bins(
+                    np.asarray(vals, dtype=np.float64))
+            col = np.asarray(data[:, f], dtype=np.float64)
+            return None, mappers[i].values_to_bins(col)
+
+        if bt is None or bt.is_trivial:
+            f_used = len(mappers)
+            dtype = np.uint8 if all(m.num_bin <= 256 for m in mappers) \
+                else np.uint16
+            bins = np.empty((n, f_used), dtype=dtype)
+            for i in range(f_used):
+                idx, codes = col_bins(i)
+                if idx is None:
+                    bins[:, i] = codes.astype(dtype)
+                else:
+                    zero_bin = mappers[i].value_to_bin(0.0)
+                    bins[:, i] = dtype(zero_bin)
+                    bins[idx, i] = codes.astype(dtype)
+        else:
+            dtype = np.uint8 if int(bt.group_num_bins.max()) <= 256 \
+                else np.uint16
+            bins = np.empty((n, bt.num_groups), dtype=dtype)
+            for g, members in enumerate(bt.groups):
+                if len(members) == 1:
+                    i = members[0]
+                    idx, codes = col_bins(i)
+                    if idx is None:
+                        bins[:, g] = codes.astype(dtype)
+                    else:
+                        bins[:, g] = dtype(mappers[i].value_to_bin(0.0))
+                        bins[idx, g] = codes.astype(dtype)
+                else:
+                    # shared column: code 0 = every member at its
+                    # most-frequent bin; later members overwrite on the
+                    # (conflict-budgeted) overlapping rows
+                    code = np.zeros(n, dtype=dtype)
+                    for i in members:
+                        idx, codes = col_bins(i)
+                        mfb = bt.skip_of[i]
+                        keep = codes != mfb
+                        rows = np.flatnonzero(keep) if idx is None else idx[keep]
+                        b = codes[keep]
+                        slot = b - (b > mfb)
+                        code[rows] = (bt.offset_of[i] + slot).astype(dtype)
+                    bins[:, g] = code
         self.bins = bins
         self.num_data = n
 
@@ -278,6 +435,7 @@ class BinnedDataset:
             "max_bin": self.max_bin,
             "monotone_constraints": self._monotone_constraints,
             "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            "bundle_groups": None if self.efb_trivial else self.bundles.groups,
             "bins_dtype": str(self.bins.dtype),
             "has_label": self.metadata.label is not None,
             "has_weights": self.metadata.weights is not None,
@@ -320,8 +478,14 @@ class BinnedDataset:
             ds.max_bin = header["max_bin"]
             ds._monotone_constraints = list(header["monotone_constraints"])
             ds.bin_mappers = [BinMapper.from_dict(d) for d in header["bin_mappers"]]
+            groups = header.get("bundle_groups")
+            if groups:
+                ds.bundles = BundleTables(
+                    [list(g) for g in groups],
+                    [m.num_bin for m in ds.bin_mappers],
+                    [m.most_freq_bin for m in ds.bin_mappers])
             dtype = np.dtype(header["bins_dtype"])
-            n, f = ds.num_data, len(ds.bin_mappers)
+            n, f = ds.num_data, len(ds.bin_mappers) if not groups else len(groups)
             ds.bins = np.frombuffer(fh.read(n * f * dtype.itemsize), dtype=dtype).reshape(n, f).copy()
             ds.metadata = Metadata(n)
             if header["has_label"]:

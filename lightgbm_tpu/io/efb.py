@@ -235,7 +235,8 @@ def bundle_eligible(m) -> bool:
 
 
 def build_bundles(nonzero_rows: List[np.ndarray], mappers,
-                  sample_cnt: int, enable: bool) -> BundleTables:
+                  sample_cnt: int, enable: bool,
+                  bundle_ok: Optional[Sequence[bool]] = None) -> BundleTables:
     """Decide bundling from per-feature sampled non-default row sets.
 
     nonzero_rows[f]: sample-row indices where feature f's bin != its
@@ -246,7 +247,8 @@ def build_bundles(nonzero_rows: List[np.ndarray], mappers,
     f_total = len(mappers)
     if not enable or f_total <= 1:
         return BundleTables.identity(num_bins)
-    bundle_ok = [bundle_eligible(m) for m in mappers]
+    if bundle_ok is None:
+        bundle_ok = [bundle_eligible(m) for m in mappers]
     groups = find_bundles(nonzero_rows, num_bins, bundle_ok, sample_cnt)
     if len(groups) >= f_total:
         return BundleTables.identity(num_bins)

@@ -235,15 +235,17 @@ class Tree:
         self._device[key] = d
         return d
 
-    def leaf_index_binned(self, bins, feature_to_miss_bin: np.ndarray):
+    def leaf_index_binned(self, bins, feature_to_miss_bin: np.ndarray,
+                          efb=None):
         """Leaf index per row over bin codes (train-time; reference
-        Tree::AddPredictionToScore's bin traversal)."""
+        Tree::AddPredictionToScore's bin traversal). ``efb`` = bundle
+        decode tables when ``bins`` holds EFB bundle codes."""
         import jax.numpy as jnp
         from ..ops.traverse import traverse_binned
         if self.num_nodes == 0:
             return jnp.zeros(bins.shape[0], dtype=jnp.int32)
         d = self._device_arrays(feature_to_miss_bin)
-        return traverse_binned(bins, **d)
+        return traverse_binned(bins, efb=efb, **d)
 
     def leaf_index_raw(self, x):
         """Leaf index per row over raw features (reference

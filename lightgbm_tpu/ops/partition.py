@@ -44,29 +44,46 @@ def _decision_go_left(binval, threshold, default_left, miss_bin, is_cat,
 @functools.partial(jax.jit, static_argnames=("capacity",))
 def partition_leaf(bins_full: jax.Array, perm: jax.Array, start, count,
                    feature, threshold, default_left, miss_bin, is_cat,
-                   cat_bitset, capacity: int):
+                   cat_bitset, capacity: int, efb=None):
     """Stable-partition one leaf's rows by a split decision.
 
     Returns (new_perm, left_count). Rows with decision True keep relative
     order at the front of the window, False after them, padding stays at
     the tail (reference ParallelPartitionRunner semantics).
+
+    ``efb``: optional (group_of, offset_of, nslots_of, skip_of) bundle
+    tables — ``bins_full`` then holds bundle codes and the feature's
+    column is decoded to its own bin space before routing (reference
+    FeatureGroup bin-offset indirection, feature_group.h).
     """
     n = perm.shape[0]
     rows, valid, read_start = leaf_window(perm, start, count, capacity)
-    binval = bins_full[jnp.where(valid, rows, 0), feature].astype(jnp.int32)
+    if efb is not None:
+        from ..io.efb import decode_bins
+        group_of = efb[0]
+        codes = bins_full[jnp.where(valid, rows, 0),
+                          group_of[feature]].astype(jnp.int32)
+        binval = decode_bins(codes, feature, efb)
+    else:
+        binval = bins_full[jnp.where(valid, rows, 0), feature].astype(jnp.int32)
     go_left = _decision_go_left(binval, threshold, default_left, miss_bin,
                                 is_cat, cat_bitset)
-    # 4-way stable key: rows before the leaf window stay at the front in
-    # original order, then left, then right, then rows after the leaf +
-    # padding — so writing the whole window back leaves other leaves'
-    # rows exactly where they were
+    # stable two-way partition via cumsum ranks (no sort): rows outside
+    # the leaf window keep their position; left rows compact to the
+    # window head in original order, right rows follow — a scatter to
+    # unique destinations, much cheaper on TPU than a stable argsort
     pos = jnp.arange(capacity, dtype=jnp.int32)
     off = jnp.asarray(start, jnp.int32) - read_start
-    key = jnp.where(pos < off, 0,
-                    jnp.where(valid, jnp.where(go_left, 1, 2), 3)).astype(jnp.int8)
-    order = jnp.argsort(key, stable=True)
-    new_rows = rows[order]
-    left_count = jnp.sum(go_left & valid).astype(jnp.int32)
+    gl = go_left & valid
+    gr = (~go_left) & valid
+    left_count = jnp.sum(gl).astype(jnp.int32)
+    rank_l = jnp.cumsum(gl) - 1
+    rank_r = jnp.cumsum(gr) - 1
+    new_pos = jnp.where(
+        gl, off + rank_l,
+        jnp.where(gr, off + left_count + rank_r, pos)).astype(jnp.int32)
+    new_rows = jnp.zeros_like(rows).at[new_pos].set(rows,
+                                                    unique_indices=True)
     if capacity <= n:
         perm = jax.lax.dynamic_update_slice(perm, new_rows, (read_start,))
     else:

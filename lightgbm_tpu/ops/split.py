@@ -433,6 +433,7 @@ def best_split(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
                feature_mask: Optional[jax.Array] = None,
                rand_thresholds: Optional[jax.Array] = None,
                cegb_delta: Optional[jax.Array] = None,
+               gain_scale: Optional[jax.Array] = None,
                any_categorical: bool = False):
     """Per-feature scans + global argmax → packed best-split record.
 
@@ -458,6 +459,11 @@ def best_split(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
         merged["cat_used_bin"] = cat["used_bin"]
         num = merged
     gains = num["gain"]
+    if gain_scale is not None:
+        # monotone split-gain penalty (reference serial_tree_learner.cpp
+        # :728-732 × ComputeMonotoneSplitGainPenalty)
+        gains = jnp.where(jnp.isfinite(gains), gains * gain_scale, gains)
+        num["gain"] = gains
     if cegb_delta is not None:
         gains = jnp.where(jnp.isfinite(gains), gains - cegb_delta, gains)
         num["gain"] = gains

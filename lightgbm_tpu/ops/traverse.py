@@ -36,15 +36,30 @@ def traverse_binned(bins: jax.Array, split_feature: jax.Array,
                     right_child: jax.Array, default_left: jax.Array,
                     miss_bin: jax.Array, is_cat: jax.Array,
                     cat_bitset_inner: jax.Array,
-                    cat_boundaries_inner: jax.Array) -> jax.Array:
+                    cat_boundaries_inner: jax.Array,
+                    efb=None) -> jax.Array:
     """Leaf index per row over bin codes (reference
     NumericalDecisionInner/CategoricalDecisionInner, tree.h:285-330).
 
-    bins: [N, F_used]; per-node arrays are the flat tree. Returns [N]
-    int32 leaf indices.
+    bins: [N, F_used] per-feature codes, or [N, G] bundle codes when
+    ``efb`` = (group_of, offset_of, nslots_of, skip_of) is given; the
+    routed feature's value is then decoded per row. Per-node arrays are
+    the flat tree. Returns [N] int32 leaf indices.
     """
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
+
+    def gather_bin(f):
+        if efb is None:
+            return jnp.take_along_axis(
+                bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        group_of, offset_of, nslots_of, skip_of = efb
+        codes = jnp.take_along_axis(
+            bins, group_of[f][:, None], axis=1)[:, 0].astype(jnp.int32)
+        rel = codes - offset_of[f]
+        inband = (rel >= 0) & (rel < nslots_of[f])
+        dec = rel + (rel >= skip_of[f])
+        return jnp.where(inband, dec, skip_of[f]).astype(jnp.int32)
 
     def cond(node):
         return jnp.any(node >= 0)
@@ -52,7 +67,7 @@ def traverse_binned(bins: jax.Array, split_feature: jax.Array,
     def body(node):
         nid = jnp.maximum(node, 0)
         f = split_feature[nid]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        b = gather_bin(f)
         thr = threshold_bin[nid]
         mb = miss_bin[nid]
         go_left = b <= thr

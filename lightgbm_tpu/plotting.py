@@ -1,13 +1,16 @@
 """Plotting utilities.
 
-API-compatible re-implementation of the reference plotting module
-(reference: python-package/lightgbm/plotting.py — plot_importance :37,
-plot_split_value_histogram :144, plot_metric :231, plot_tree /
-create_tree_digraph :549/:461 via graphviz).
+Re-implements the reference plotting surface (reference:
+python-package/lightgbm/plotting.py — plot_importance :37,
+plot_split_value_histogram :144, plot_metric :231, plot_tree :549 /
+create_tree_digraph :461) on top of this package's Booster
+introspection API. Matplotlib/graphviz are imported lazily so the
+training stack never depends on them.
 """
 from __future__ import annotations
 
 from copy import deepcopy
+from io import BytesIO
 from typing import Optional
 
 import numpy as np
@@ -16,18 +19,57 @@ from .basic import Booster, LightGBMError
 from .sklearn import LGBMModel
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+# ---------------------------------------------------------------------------
+# shared axis helpers
+# ---------------------------------------------------------------------------
 
-
-def _to_booster(booster) -> Booster:
-    if isinstance(booster, LGBMModel):
-        return booster.booster_
-    if isinstance(booster, Booster):
-        return booster
+def _resolve_booster(obj) -> Booster:
+    if isinstance(obj, LGBMModel):
+        return obj.booster_
+    if isinstance(obj, Booster):
+        return obj
     raise TypeError("booster must be Booster or LGBMModel.")
 
+
+def _require_pair(value, name: str):
+    if not isinstance(value, tuple) or len(value) != 2:
+        raise TypeError(f"{name} must be a tuple of 2 elements.")
+    return value
+
+
+def _new_axes(figsize, dpi):
+    import matplotlib.pyplot as plt
+    if figsize is not None:
+        _require_pair(figsize, "figsize")
+    _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def _padded(lo: float, hi: float, pad: float):
+    span = hi - lo
+    return (lo - span * pad, hi + span * pad)
+
+
+def _finish_axes(ax, *, title, xlabel, ylabel, grid, ylim=None):
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _num_text(value, precision: int) -> str:
+    return f"{value:.{precision}f}" if isinstance(value, float) else str(value)
+
+
+# ---------------------------------------------------------------------------
+# public plots
+# ---------------------------------------------------------------------------
 
 def plot_importance(booster, ax=None, height: float = 0.2, xlim=None,
                     ylim=None, title: str = "Feature importance",
@@ -37,52 +79,43 @@ def plot_importance(booster, ax=None, height: float = 0.2, xlim=None,
                     max_num_features: Optional[int] = None,
                     ignore_zero: bool = True, figsize=None, dpi=None,
                     grid: bool = True, precision: int = 3, **kwargs):
-    """reference plotting.py:37."""
-    import matplotlib.pyplot as plt
-
-    booster = _to_booster(booster)
-    importance = booster.feature_importance(importance_type=importance_type)
-    feature_name = booster.feature_name()
-    if not len(importance):
+    """Horizontal bar chart of per-feature importance
+    (reference plotting.py:37)."""
+    bst = _resolve_booster(booster)
+    scores = bst.feature_importance(importance_type=importance_type)
+    if not len(scores):
         raise ValueError("Booster's feature_importance is empty.")
+    names = bst.feature_name()
 
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    ranked = sorted(zip(scores, names))          # ascending for barh
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        ranked = [p for p in ranked if p[0] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples) if tuples else ((), ())
+        ranked = ranked[-max_num_features:]
+    values = [p[0] for p in ranked]
+    labels = [p[1] for p in ranked]
 
     if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
-                va="center")
-    ax.set_yticks(ylocs)
+        ax = _new_axes(figsize, dpi)
+    positions = np.arange(len(ranked))
+    ax.barh(positions, values, height=height, align="center", **kwargs)
+    for pos, val in zip(positions, values):
+        ax.text(val + 1, pos, _num_text(val, precision)
+                if importance_type == "gain" else str(val), va="center")
+    ax.set_yticks(positions)
     ax.set_yticklabels(labels)
+
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
         xlim = (0, max(values) * 1.1 if values else 1)
-    ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         ylim = (-1, len(values))
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    ax.set_xlim(xlim)
+    return _finish_axes(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                        grid=grid, ylim=ylim)
 
 
 def plot_split_value_histogram(booster, feature, bins=None, ax=None,
@@ -91,38 +124,36 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
                                xlabel="Feature split value", ylabel="Count",
                                figsize=None, dpi=None, grid: bool = True,
                                **kwargs):
-    """reference plotting.py:144."""
-    import matplotlib.pyplot as plt
-
-    booster = _to_booster(booster)
-    hist, split_bins = booster.get_split_value_histogram(feature, bins=bins,
-                                                         xgboost_style=False)
-    if np.count_nonzero(hist) == 0:
+    """Bar chart of where the model split a feature
+    (reference plotting.py:144)."""
+    bst = _resolve_booster(booster)
+    counts, edges = bst.get_split_value_histogram(feature, bins=bins,
+                                                  xgboost_style=False)
+    if not np.any(counts):
         raise ValueError(f"Cannot plot split value histogram, "
                          f"because feature {feature} was not used in splitting")
-    width = width_coef * (split_bins[1] - split_bins[0])
-    centred = (split_bins[:-1] + split_bins[1:]) / 2
 
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ax.bar(centred, hist, width=width, align="center", **kwargs)
-    if xlim is None:
-        range_result = split_bins[-1] - split_bins[0]
-        xlim = (split_bins[0] - range_result * 0.2,
-                split_bins[-1] + range_result * 0.2)
+        ax = _new_axes(figsize, dpi)
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    ax.bar(centers, counts, width=width_coef * (edges[1] - edges[0]),
+           align="center", **kwargs)
+
+    if xlim is not None:
+        _require_pair(xlim, "xlim")
+    else:
+        xlim = _padded(edges[0], edges[-1], 0.2)
+    if ylim is not None:
+        _require_pair(ylim, "ylim")
+    else:
+        ylim = (0, max(counts) * 1.1)
     ax.set_xlim(xlim)
-    ax.set_ylim(ylim if ylim is not None else (0, max(hist) * 1.1))
     if title is not None:
-        title = title.replace("@feature@", str(feature))
-        title = title.replace("@index/name@",
-                              "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+        kind = "name" if isinstance(feature, str) else "index"
+        title = title.replace("@feature@", str(feature)) \
+                     .replace("@index/name@", kind)
+    return _finish_axes(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                        grid=grid, ylim=ylim)
 
 
 def plot_metric(booster, metric: Optional[str] = None,
@@ -130,154 +161,137 @@ def plot_metric(booster, metric: Optional[str] = None,
                 title: str = "Metric during training",
                 xlabel: str = "Iterations", ylabel: str = "auto",
                 figsize=None, dpi=None, grid: bool = True):
-    """reference plotting.py:231."""
-    import matplotlib.pyplot as plt
-
+    """Line chart of a recorded metric across iterations per dataset
+    (reference plotting.py:231)."""
     if isinstance(booster, LGBMModel):
-        eval_results = deepcopy(booster.evals_result_)
+        history = deepcopy(booster.evals_result_)
     elif isinstance(booster, dict):
-        eval_results = deepcopy(booster)
+        history = deepcopy(booster)
     elif isinstance(booster, Booster):
-        raise TypeError("booster must be dict or LGBMModel. To use plot_metric "
-                        "with Booster type, first record the metrics using "
-                        "record_evaluation callback then pass that to plot_metric as argument `booster`")
+        raise TypeError("booster must be dict or LGBMModel. To use "
+                        "plot_metric with Booster type, first record the "
+                        "metrics using record_evaluation callback then pass "
+                        "that to plot_metric as argument `booster`")
     else:
         raise TypeError("booster must be dict or LGBMModel.")
-    if not eval_results:
+    if not history:
         raise ValueError("eval results cannot be empty.")
 
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-
     if dataset_names is None:
-        dataset_names = iter(eval_results.keys())
-    elif not isinstance(dataset_names, (list, tuple, set)):
+        names = list(history.keys())
+    elif isinstance(dataset_names, (list, tuple, set)):
+        names = list(dataset_names)
+    else:
         raise ValueError("dataset_names should be iterable and cannot be empty")
-    else:
-        dataset_names = iter(dataset_names)
 
-    name = next(dataset_names)
-    metrics_for_one = eval_results[name]
-    num_metric = len(metrics_for_one)
+    first_series = history[names[0]]
     if metric is None:
-        if num_metric > 1:
-            raise ValueError("more than one metric available, pick one with the 'metric' parameter")
-        metric, results = metrics_for_one.popitem()
-    else:
-        if metric not in metrics_for_one:
-            raise ValueError("No given metric in eval results.")
-        results = metrics_for_one[metric]
-    num_iteration = len(results)
-    max_result = max(results)
-    min_result = min(results)
-    x_ = range(num_iteration)
-    ax.plot(x_, results, label=name)
+        if len(first_series) > 1:
+            raise ValueError("more than one metric available, pick one with "
+                             "the 'metric' parameter")
+        metric = next(iter(first_series))
+    elif metric not in first_series:
+        raise ValueError("No given metric in eval results.")
 
-    for name in dataset_names:
-        metrics_for_one = eval_results[name]
-        results = metrics_for_one[metric]
-        max_result = max(max(results), max_result)
-        min_result = min(min(results), min_result)
-        ax.plot(x_, results, label=name)
+    if ax is None:
+        ax = _new_axes(figsize, dpi)
+    lo, hi, n_iter = np.inf, -np.inf, 0
+    for name in names:
+        series = history[name][metric]
+        n_iter = max(n_iter, len(series))
+        lo, hi = min(lo, min(series)), max(hi, max(series))
+        ax.plot(range(len(series)), series, label=name)
     ax.legend(loc="best")
+
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
-        xlim = (0, num_iteration)
+        xlim = (0, n_iter)
+    if ylim is not None:
+        _require_pair(ylim, "ylim")
+    else:
+        ylim = _padded(lo, hi, 0.2)
     ax.set_xlim(xlim)
-    if ylim is None:
-        range_result = max_result - min_result
-        ylim = (min_result - range_result * 0.2, max_result + range_result * 0.2)
-    ax.set_ylim(ylim)
-    if ylabel == "auto":
-        ylabel = metric
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    return _finish_axes(ax, title=title, xlabel=xlabel,
+                        ylabel=metric if ylabel == "auto" else ylabel,
+                        grid=grid, ylim=ylim)
 
 
-def _to_graphviz(tree_info: dict, show_info, feature_names, precision=3,
-                 orientation="horizontal", **kwargs):
-    """reference plotting.py:380 _to_graphviz."""
-    try:
-        from graphviz import Digraph
-    except ImportError:
-        raise ImportError("You must install graphviz and restart your session "
-                          "to plot tree.")
+# ---------------------------------------------------------------------------
+# tree rendering
+# ---------------------------------------------------------------------------
 
-    def add(root, total_count, parent=None, decision=None):
-        if "split_index" in root:
-            name = f"split{root['split_index']}"
-            if feature_names is not None:
-                label = f"<B>{feature_names[root['split_feature']]}</B>"
-            else:
-                label = f"feature <B>{root['split_feature']}</B>"
-            lbl = f"<{label} {root['decision_type']} "
-            lbl += f"<B>{_float2str(root['threshold'], precision)}</B>>"
-            graph.node(name, label=lbl)
-            add(root["left_child"], total_count, name, "yes")
-            add(root["right_child"], total_count, name, "no")
-        else:
-            name = f"leaf{root['leaf_index']}"
-            label = f"leaf {root['leaf_index']}: "
-            label += f"<B>{_float2str(root['leaf_value'], precision)}</B>"
-            if "leaf_count" in show_info and "leaf_count" in root:
-                label += f"<br/>count: {root['leaf_count']}"
-            graph.node(name, label=f"<{label}>")
-        if parent is not None:
-            graph.edge(parent, name, decision)
-
-    graph = Digraph(**kwargs)
-    rankdir = "LR" if orientation == "horizontal" else "TB"
-    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
-    add(tree_info["tree_structure"], tree_info.get("num_leaves", 0))
-    return graph
+def _node_label(node: dict, feature_names, precision: int) -> str:
+    feat = node["split_feature"]
+    shown = feature_names[feat] if feature_names is not None \
+        else f"feature <B>{feat}</B>"
+    if feature_names is not None:
+        shown = f"<B>{shown}</B>"
+    thr = _num_text(node["threshold"], precision)
+    return f"<{shown} {node['decision_type']} <B>{thr}</B>>"
 
 
-def _float2str(value, precision: int = 3) -> str:
-    return f"{value:.{precision}f}" if isinstance(value, float) else str(value)
+def _leaf_label(node: dict, show_info, precision: int) -> str:
+    body = (f"leaf {node['leaf_index']}: "
+            f"<B>{_num_text(node['leaf_value'], precision)}</B>")
+    if "leaf_count" in show_info and "leaf_count" in node:
+        body += f"<br/>count: {node['leaf_count']}"
+    return f"<{body}>"
+
+
+def _render_subtree(graph, node: dict, feature_names, show_info,
+                    precision: int, parent: Optional[str], edge: Optional[str]):
+    is_split = "split_index" in node
+    if is_split:
+        gv_name = f"split{node['split_index']}"
+        graph.node(gv_name, label=_node_label(node, feature_names, precision))
+    else:
+        gv_name = f"leaf{node['leaf_index']}"
+        graph.node(gv_name, label=_leaf_label(node, show_info, precision))
+    if parent is not None:
+        graph.edge(parent, gv_name, edge)
+    if is_split:
+        _render_subtree(graph, node["left_child"], feature_names, show_info,
+                        precision, gv_name, "yes")
+        _render_subtree(graph, node["right_child"], feature_names, show_info,
+                        precision, gv_name, "no")
 
 
 def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
                         precision: int = 3, orientation: str = "horizontal",
                         **kwargs):
-    """reference plotting.py:461."""
-    booster = _to_booster(booster)
-    model = booster.dump_model()
-    tree_infos = model["tree_info"]
-    feature_names = model.get("feature_names", None)
-    if tree_index < len(tree_infos):
-        tree_info = tree_infos[tree_index]
-    else:
+    """Graphviz Digraph of one tree (reference plotting.py:461)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz and restart your "
+                          "session to plot tree.")
+    bst = _resolve_booster(booster)
+    dump = bst.dump_model()
+    trees = dump["tree_info"]
+    if tree_index >= len(trees):
         raise IndexError("tree_index is out of range.")
-    if show_info is None:
-        show_info = []
-    return _to_graphviz(tree_info, show_info, feature_names, precision,
-                        orientation, **kwargs)
+    graph = Digraph(**kwargs)
+    graph.attr("graph", nodesep="0.05", ranksep="0.3",
+               rankdir="LR" if orientation == "horizontal" else "TB")
+    _render_subtree(graph, trees[tree_index]["tree_structure"],
+                    dump.get("feature_names"), show_info or [],
+                    precision, None, None)
+    return graph
 
 
 def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
               show_info=None, precision: int = 3,
               orientation: str = "horizontal", **kwargs):
-    """reference plotting.py:549."""
+    """Render one tree into a matplotlib axes via graphviz PNG
+    (reference plotting.py:549)."""
     import matplotlib.image as mpimg
-    import matplotlib.pyplot as plt
-    import io
 
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+        ax = _new_axes(figsize, dpi)
     graph = create_tree_digraph(booster=booster, tree_index=tree_index,
                                 show_info=show_info, precision=precision,
                                 orientation=orientation, **kwargs)
-    s = io.BytesIO()
-    s.write(graph.pipe(format="png"))
-    s.seek(0)
-    img = mpimg.imread(s)
-    ax.imshow(img)
+    ax.imshow(mpimg.imread(BytesIO(graph.pipe(format="png"))))
     ax.axis("off")
     return ax

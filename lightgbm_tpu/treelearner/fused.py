@@ -67,6 +67,12 @@ def fused_supported(config: Config, dataset: BinnedDataset,
             or config.cegb_penalty_feature_coupled
             or config.cegb_penalty_feature_lazy):
         return False
+    if config.monotone_constraints and (
+            config.monotone_constraints_method != "basic"
+            or config.monotone_penalty > 0):
+        # intermediate mode re-searches arbitrary leaves after a split —
+        # host-loop territory (treelearner/monotone.py)
+        return False
     if objective is not None and objective.is_renew_tree_output:
         return False
     if dataset.num_features == 0:
@@ -148,13 +154,37 @@ class FusedSerialGrower:
             (m.num_bin - 1 if m.missing_type == 2 else
              (m.default_bin if m.missing_type == 1 else -1))
             for m in mappers], dtype=jnp.int32)
+        # EFB bundle views (None on dense/trivial datasets)
+        self._efb_dev = dataset.device_bundle_tables()
+        self._efb_hist = dataset.device_hist_tables()
+        self.group_max_bin = dataset.group_max_bins
+
+        # score updates can reuse the partition's leaf assignment only
+        # when every scored row is in-bag (no bagging/GOSS/RF); with
+        # bagging the out-of-bag rows are never partitioned and the
+        # fallback is the tree re-traversal
+        bag_active = (
+            (config.bagging_freq > 0
+             and (config.bagging_fraction < 1.0
+                  or config.pos_bagging_fraction < 1.0
+                  or config.neg_bagging_fraction < 1.0))
+            or config.boosting in ("goss", "rf"))
+        self._score_from_partition = not bag_active
+
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         n = dataset.num_data
+        # capacity ladder for the lax.switch histogram/partition
+        # branches. Each branch duplicates the full kernel in the
+        # compiled program, so XLA compile time grows with the ladder
+        # size — factor 4 keeps it at ~log4(N) branches (5 at 1M rows
+        # vs 13 for factor 2) for at most 4x padded work on mid-size
+        # leaves (the dominant root/early splits sit in the top bucket
+        # either way, and the smaller-child trick bounds the rest).
         self._caps = []
-        c = 256
+        c = 4096
         while c < n:
             self._caps.append(c)
-            c *= 2
+            c *= 4
         self._caps.append(c)
         self._grow_jit = jax.jit(self._grow_tree,
                                  static_argnames=("compute_score_update",))
@@ -163,13 +193,23 @@ class FusedSerialGrower:
     def _leaf_hist_switch(self, perm, start, count, grad, hess):
         """Histogram of a leaf window with dynamic cost: lax.switch over
         power-of-two capacity buckets (the static-shape answer to the
-        reference's exact-size ordered-gradient gathers)."""
+        reference's exact-size ordered-gradient gathers). With EFB the
+        histogram runs over G << F bundle columns and is gathered back
+        to per-feature space (FixHistogram mfb reconstruction)."""
         B = self.max_num_bin
+        Bg = self.group_max_bin
+        efb_hist = self._efb_hist
 
         def branch(cap):
             def fn(perm, start, count, grad, hess):
-                return H.leaf_histogram(self.bins, perm, start, count, grad,
-                                        hess, cap, B)
+                if efb_hist is None:
+                    return H.leaf_histogram(self.bins, perm, start, count,
+                                            grad, hess, cap, B)
+                from ..io.efb import per_feature_hist
+                ghist = H.leaf_histogram(self.bins, perm, start, count,
+                                         grad, hess, cap, Bg)
+                total = ghist[0].sum(axis=0)
+                return per_feature_hist(ghist, efb_hist, total[0], total[1])
             return fn
 
         branches = [branch(c) for c in self._caps]
@@ -191,7 +231,8 @@ class FusedSerialGrower:
             def fn(perm, start, count, feature, thr, dl, miss_bin):
                 return partition_leaf(self.bins, perm, start, count, feature,
                                       thr, dl, miss_bin, jnp.bool_(False),
-                                      jnp.zeros(1, jnp.uint32), cap)
+                                      jnp.zeros(1, jnp.uint32), cap,
+                                      efb=self._efb_dev)
             return fn
 
         branches = [branch(c) for c in self._caps]
@@ -413,8 +454,31 @@ class FusedSerialGrower:
 
         leaf_of_row = None
         if compute_score_update:
-            leaf_of_row = self._traverse_device(tree_arrays)
+            if self._score_from_partition:
+                # the partition already assigned every row to a leaf:
+                # leaf intervals [start, start+count) tile [0, N), so a
+                # searchsorted over the sorted starts + a scatter through
+                # the permutation yields leaf-of-row without re-walking
+                # the tree (the DataPartition shortcut of the reference's
+                # ScoreUpdater::AddScore, score_updater.hpp:88 — here it
+                # replaces an ~O(depth) gather chain per iteration)
+                leaf_of_row = self._leaf_ids_from_partition(st, n)
+            else:
+                leaf_of_row = self._traverse_device(tree_arrays)
         return tree_arrays, leaf_of_row
+
+    def _leaf_ids_from_partition(self, st: FusedTreeState, n: int):
+        L = self.num_leaves
+        lid = jnp.arange(L, dtype=jnp.int32)
+        valid = lid < st.n_leaves
+        starts = jnp.where(valid, st.leaf_start, jnp.int32(n) + 1)
+        order = jnp.argsort(starts)             # tiny: [num_leaves]
+        sorted_starts = starts[order]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        k = jnp.searchsorted(sorted_starts, pos, side="right") - 1
+        pos_leaf = order[jnp.maximum(k, 0)]
+        return jnp.zeros(n, jnp.int32).at[st.perm].set(pos_leaf,
+                                                       unique_indices=True)
 
     def _traverse_device(self, ta) -> jax.Array:
         return self.traverse_bins(ta, self.bins)
@@ -426,6 +490,19 @@ class FusedSerialGrower:
         n = bins.shape[0]
         node = jnp.where(ta["n_leaves"] > 1, 0, -1) * jnp.ones(n, jnp.int32)
         miss_tbl = self.feature_miss_bin
+        efb = self._efb_dev
+
+        def gather_bin(f):
+            if efb is None:
+                return jnp.take_along_axis(
+                    bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+            group_of, offset_of, nslots_of, skip_of = efb
+            codes = jnp.take_along_axis(
+                bins, group_of[f][:, None], axis=1)[:, 0].astype(jnp.int32)
+            rel = codes - offset_of[f]
+            inband = (rel >= 0) & (rel < nslots_of[f])
+            dec = rel + (rel >= skip_of[f])
+            return jnp.where(inband, dec, skip_of[f]).astype(jnp.int32)
 
         def cond(node):
             return jnp.any(node >= 0)
@@ -433,7 +510,7 @@ class FusedSerialGrower:
         def body(node):
             nid = jnp.maximum(node, 0)
             f = ta["split_feature"][nid]
-            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+            b = gather_bin(f)
             thr = ta["threshold_bin"][nid]
             mb = miss_tbl[f]
             go_left = b <= thr

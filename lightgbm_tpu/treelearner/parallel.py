@@ -360,23 +360,28 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             res = S.numerical_split_scan(h, meta, local_cfg, sg, sh_,
                                          count[0], 0.0, -jnp.inf, jnp.inf)
             gains = jnp.where(jnp.isfinite(res["gain"]), res["gain"], -jnp.inf)
-            k = min(top_k, gains.shape[0])
+            f_total = gains.shape[0]
+            k = min(top_k, f_total)
             _, top_idx = jax.lax.top_k(gains, k)
-            votes = jnp.zeros(gains.shape[0], jnp.int32).at[top_idx].add(1)
-            votes = jax.lax.psum(votes, "data")
+            votes = jnp.zeros(f_total, jnp.int32).at[top_idx].add(1)
+            votes = jax.lax.psum(votes, "data")        # tiny: [F] int32
             # global candidates: top 2k features by votes (GlobalVoting,
             # reference :152-183)
-            k2 = min(2 * top_k, gains.shape[0])
-            _, selected = jax.lax.top_k(votes, k2)
-            mask = jnp.zeros(gains.shape[0], bool).at[selected].set(True)
-            h_masked = jnp.where(mask[:, None, None], h, 0.0)
-            hist_global = jax.lax.psum(h_masked, "data")
-            # exact global sums from the UNMASKED local histogram (the
-            # reference reduces the root (count, Σg, Σh) tuple fully)
+            k2 = min(2 * top_k, f_total)
             sg_true = jax.lax.psum(sg, "data")
             sh_true = jax.lax.psum(sh_, "data")
-            # non-selected features keep local-only histograms zeroed;
-            # the replicated scan will simply not pick them
+            if k2 >= f_total:
+                return jax.lax.psum(h, "data"), sg_true, sh_true
+            # the vote tally is replicated after its psum, so every
+            # shard computes the SAME selected set; only the selected
+            # features' histogram slab rides ICI — [2k, B, 2] instead of
+            # [F, B, 2], the PV-Tree saving (CopyLocalHistogram :185 +
+            # ReduceScatter of selected buffers :343)
+            _, selected = jax.lax.top_k(votes, k2)
+            h_sel = jax.lax.psum(h[selected], "data")  # [2k, B, 2]
+            hist_global = jnp.zeros_like(h).at[selected].set(h_sel)
+            # non-selected features keep zero histograms; the replicated
+            # scan will simply not pick them
             return hist_global, sg_true, sh_true
         return fn
 

@@ -69,6 +69,8 @@ class SerialTreeGrower:
 
         monotone = [dataset.monotone_constraint(i) for i in range(self.num_features)]
         self.use_monotone = any(m != 0 for m in monotone)
+        self._monotone_np = np.asarray(monotone, dtype=np.int32)
+        self._mono_state = None  # per-tree, created in grow()
         penalty = list(config.feature_contri) + [1.0] * (self.num_features - len(config.feature_contri))
         # miss bin per feature for bin-space routing (NaN bin = last,
         # Zero mode = default bin; -1 = no routing). Mirrors
@@ -102,6 +104,12 @@ class SerialTreeGrower:
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=config.min_data_per_group)
 
+        # EFB bundle views (None on dense/trivial datasets — all hist
+        # and partition calls then take the direct per-feature path)
+        self._efb_dev = dataset.device_bundle_tables()
+        self._efb_hist = dataset.device_hist_tables()
+        self.group_max_bin = dataset.group_max_bins
+
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         self._extra_rng = np.random.RandomState(config.extra_seed)
         self._split_jit = jax.jit(self._split_packed)
@@ -119,12 +127,12 @@ class SerialTreeGrower:
     # ------------------------------------------------------------------
     def _split_packed(self, hist, sum_g, sum_h, num_data, parent_output,
                       cmin, cmax, feature_mask, rand_thresholds,
-                      cegb_delta=None):
+                      cegb_delta=None, gain_scale=None):
         res = S.best_split(hist, self.meta, self.split_cfg, sum_g, sum_h,
                            num_data, parent_output, cmin, cmax,
                            feature_mask=feature_mask,
                            rand_thresholds=rand_thresholds,
-                           cegb_delta=cegb_delta,
+                           cegb_delta=cegb_delta, gain_scale=gain_scale,
                            any_categorical=self.any_categorical)
         f = res["best_feature"]
         vec = jnp.stack([
@@ -155,20 +163,32 @@ class SerialTreeGrower:
     @functools.lru_cache(maxsize=64)
     def _hist_fn(self, capacity: int):
         B = self.max_num_bin
+        Bg = self.group_max_bin
+        efb_hist = self._efb_hist
 
         @jax.jit
         def fn(bins, perm, start, count, grad, hess):
-            return H.leaf_histogram(bins, perm, start, count, grad, hess,
-                                    capacity, B)
+            if efb_hist is None:
+                return H.leaf_histogram(bins, perm, start, count, grad, hess,
+                                        capacity, B)
+            # bundle-space histogram over G << F columns, then gather to
+            # per-feature space with FixHistogram mfb reconstruction
+            from ..io.efb import per_feature_hist
+            ghist = H.leaf_histogram(bins, perm, start, count, grad, hess,
+                                     capacity, Bg)
+            total = ghist[0].sum(axis=0)  # every row in exactly one code
+            return per_feature_hist(ghist, efb_hist, total[0], total[1])
         return fn
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn(self, capacity: int):
+        efb = self._efb_dev
+
         def fn(bins, perm, start, count, feature, threshold, default_left,
                miss_bin, is_cat, cat_bitset):
             return partition_leaf(bins, perm, start, count, feature,
                                   threshold, default_left, miss_bin, is_cat,
-                                  cat_bitset, capacity)
+                                  cat_bitset, capacity, efb=efb)
         return fn
 
     # ------------------------------------------------------------------
@@ -249,6 +269,11 @@ class SerialTreeGrower:
         tree = Tree(cfg.num_leaves, track_branch_features=bool(self._interaction_sets))
         tree_mask = self._feature_mask_tree()
         rand_thr = self._rand_thresholds()
+        if self.use_monotone:
+            from .monotone import MonotoneState
+            self._mono_state = MonotoneState(
+                cfg.monotone_constraints_method, cfg.num_leaves,
+                self._monotone_np)
 
         root = _Leaf(0, num_data, 0.0, 0.0, 0.0, 0)
         cap = next_capacity(num_data)
@@ -293,12 +318,19 @@ class SerialTreeGrower:
             return None
         mask = self._feature_mask_node(tree_mask, branch_features)
         cegb = self._cegb_delta(leaf)
+        scale = None
+        if self.use_monotone and self.config.monotone_penalty > 0:
+            from .monotone import monotone_penalty_factor
+            fac = monotone_penalty_factor(leaf.depth,
+                                          self.config.monotone_penalty)
+            scale = jnp.asarray(
+                np.where(self._monotone_np != 0, fac, 1.0), jnp.float32)
         vec, ivec, cat = self._split_jit(
             leaf.hist, jnp.float32(leaf.sum_g), jnp.float32(leaf.sum_h),
             jnp.int32(leaf.count), jnp.float32(leaf.output),
             jnp.float32(leaf.cmin), jnp.float32(leaf.cmax),
             jnp.asarray(mask), rand_thr if rand_thr is not None
-            else jnp.zeros(self.num_features, jnp.int32), cegb)
+            else jnp.zeros(self.num_features, jnp.int32), cegb, scale)
         v = np.asarray(vec, dtype=np.float64)
         iv = np.asarray(ivec, dtype=np.int64)
         if not iv[5] or not np.isfinite(v[0]) or v[0] <= 0.0:
@@ -328,6 +360,9 @@ class SerialTreeGrower:
         mapper = self.dataset.bin_mappers[fi]
         real_feature = self.dataset.real_feature_index[fi]
         is_cat = mapper.bin_type == BIN_CATEGORICAL
+        mono = self.dataset.monotone_constraint(fi)
+        if self._mono_state is not None:
+            self._mono_state.before_split(tree, lid, mono)
 
         if is_cat:
             bin_set = self._cat_bins(best)
@@ -364,17 +399,19 @@ class SerialTreeGrower:
         lc = int(left_count)
         rc = leaf.count - lc
 
-        # monotone constraint propagation (basic method; reference
-        # monotone_constraints.hpp BasicLeafConstraints::Update)
+        # monotone constraint propagation (reference
+        # monotone_constraints.hpp Basic/IntermediateLeafConstraints)
         lcmin, lcmax, rcmin, rcmax = leaf.cmin, leaf.cmax, leaf.cmin, leaf.cmax
-        if self.use_monotone:
-            mono = self.dataset.monotone_constraint(fi)
-            if mono != 0:
-                mid = (best["left_output"] + best["right_output"]) / 2.0
-                if mono > 0:
-                    lcmax, rcmin = min(lcmax, mid), max(rcmin, mid)
-                else:
-                    lcmin, rcmax = max(lcmin, mid), min(rcmax, mid)
+        updated_leaves: List[int] = []
+        if self._mono_state is not None:
+            ms = self._mono_state
+            updated_leaves = ms.update(
+                tree, lid, right_leaf, mono, not is_cat,
+                best["left_output"], best["right_output"], fi,
+                best["threshold"],
+                lambda l: l in leaves and leaves[l].best is not None)
+            lcmin, lcmax = ms.cmin[lid], ms.cmax[lid]
+            rcmin, rcmax = ms.cmin[right_leaf], ms.cmax[right_leaf]
 
         left = _Leaf(leaf.start, lc, best["left_sum_gradient"],
                      best["left_sum_hessian"], best["left_output"],
@@ -405,6 +442,21 @@ class SerialTreeGrower:
 
         leaves[lid] = left
         leaves[right_leaf] = right
+        # intermediate monotone mode: leaves whose bounds tightened must
+        # re-search their best split (reference serial_tree_learner.cpp
+        # :650-658 consuming leaves_need_update)
+        for ul in updated_leaves:
+            if ul in (lid, right_leaf):
+                continue
+            u = leaves[ul]
+            u.cmin = self._mono_state.cmin[ul]
+            u.cmax = self._mono_state.cmax[ul]
+            ub = None
+            if self._interaction_sets:
+                ub = {self.dataset.inner_feature_index[f]
+                      for f in tree.branch_features[ul]
+                      if f in self.dataset.inner_feature_index}
+            u.best = self._compute_best(u, tree_mask, ub, rand_thr)
         if self._cegb_enabled:
             self._cegb_coupled_used[fi] = True
         return new_perm

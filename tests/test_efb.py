@@ -1,0 +1,160 @@
+"""Exclusive Feature Bundling + sparse data plane tests.
+
+Oracle strategy: on synthetic data whose sparse features are TRULY
+mutually exclusive, bundling is lossless — bin codes, histograms, and
+the trained model must match the dense unbundled path exactly (the
+reference's EFB guarantees the same: dataset.cpp FastFeatureBundling
+only merges features whose sampled conflict count is ~0).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def make_exclusive_sparse(n=600, groups=8, feats_per_group=6, seed=0):
+    """Dense matrix of groups*feats_per_group features; inside each
+    group exactly one feature is nonzero per row -> zero conflicts."""
+    rng = np.random.RandomState(seed)
+    f = groups * feats_per_group
+    X = np.zeros((n, f))
+    for g in range(groups):
+        owner = rng.randint(0, feats_per_group, size=n)
+        vals = rng.rand(n) * (g + 1) + 0.1
+        X[np.arange(n), g * feats_per_group + owner] = vals
+    y = (X[:, 0] + X[:, feats_per_group] * 2 + rng.randn(n) * 0.05 > 0.4)
+    return X, y.astype(np.float64)
+
+
+def test_bundles_found_and_lossless_codes():
+    X, _ = make_exclusive_sparse()
+    cfg = Config.from_params({"min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg)
+    assert ds.bundles is not None, "exclusive features should bundle"
+    assert ds.bins.shape[1] < ds.num_features
+    cfg_off = Config.from_params({"enable_bundle": False,
+                                  "min_data_in_leaf": 5})
+    ds_off = BinnedDataset.from_matrix(X, cfg_off)
+    assert ds_off.bundles is None
+    # decoded per-feature view must equal the unbundled encoding exactly
+    np.testing.assert_array_equal(ds.feature_bins(), ds_off.bins)
+
+
+def test_sparse_input_matches_dense():
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = make_exclusive_sparse()
+    cfg = Config.from_params({"min_data_in_leaf": 5})
+    ds_dense = BinnedDataset.from_matrix(X, cfg)
+    ds_sparse = BinnedDataset.from_matrix(sp.csr_matrix(X), cfg)
+    assert ds_sparse.bins.shape == ds_dense.bins.shape
+    np.testing.assert_array_equal(ds_sparse.bins, ds_dense.bins)
+
+
+def test_bundled_histogram_matches_feature_histogram():
+    import jax.numpy as jnp
+    from lightgbm_tpu.io.efb import per_feature_hist
+    from lightgbm_tpu.ops.histogram import histogram_scatter
+
+    X, _ = make_exclusive_sparse(n=400)
+    cfg = Config.from_params({"min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg)
+    assert not ds.efb_trivial
+    rng = np.random.RandomState(1)
+    g = jnp.asarray(rng.randn(400).astype(np.float32))
+    h = jnp.asarray(rng.rand(400).astype(np.float32) + 0.5)
+
+    ghist = histogram_scatter(ds.device_bins(), g, h, ds.group_max_bins)
+    total = ghist[0].sum(axis=0)
+    fhist = per_feature_hist(ghist, ds.device_hist_tables(),
+                             total[0], total[1])
+    oracle = histogram_scatter(jnp.asarray(ds.feature_bins()), g, h,
+                               ds.max_num_bin)
+    np.testing.assert_allclose(np.asarray(fhist), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_train_parity_bundled_vs_dense():
+    X, y = make_exclusive_sparse()
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "metric": "auc"}
+    bst_on = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8, verbose_eval=False)
+    bst_off = lgb.train(dict(params, enable_bundle=False),
+                        lgb.Dataset(X, label=y),
+                        num_boost_round=8, verbose_eval=False)
+    assert not bst_on._gbdt.train_data.efb_trivial
+    assert bst_off._gbdt.train_data.efb_trivial
+    p_on = bst_on.predict(X)
+    p_off = bst_off.predict(X)
+    np.testing.assert_allclose(p_on, p_off, rtol=1e-3, atol=1e-4)
+
+
+def test_sparse_train_and_predict_end_to_end():
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = make_exclusive_sparse(n=800)
+    Xs = sp.csr_matrix(X)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "metric": "auc"}
+    dtrain = lgb.Dataset(Xs, label=y)
+    dvalid = dtrain.create_valid(sp.csr_matrix(X[:200]), label=y[:200])
+    evals = {}
+    bst = lgb.train(params, dtrain, num_boost_round=10,
+                    valid_sets=[dvalid], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)],
+                    verbose_eval=False)
+    p_sparse = bst.predict(Xs[:100])
+    p_dense = bst.predict(X[:100])
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-6)
+    auc = evals["v"]["auc"][-1]
+    assert auc > 0.9, f"sparse-input training failed to learn (auc={auc})"
+
+
+def test_wide_sparse_memory_footprint():
+    """A wide, 95%-sparse dataset must bundle into far fewer physical
+    columns than features (the reference's Allstate/Bosch story)."""
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(3)
+    n, f = 2000, 600
+    density = 0.02
+    nnz = int(n * f * density)
+    rows = rng.randint(0, n, nnz)
+    cols = rng.randint(0, f, nnz)
+    vals = rng.rand(nnz) + 0.1
+    Xs = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    y = (np.asarray(Xs[:, :10].sum(axis=1)).ravel() > 0.2).astype(float)
+    ds = lgb.Dataset(Xs, label=y)
+    ds.construct()
+    h = ds._handle
+    assert h.bins.shape[1] <= h.num_features // 4, \
+        f"{h.num_features} features packed into {h.bins.shape[1]} columns"
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=5,
+                    verbose_eval=False)
+    p = bst.predict(Xs[:50])
+    assert np.all(np.isfinite(p))
+
+
+def test_binary_cache_roundtrip_with_bundles(tmp_path):
+    X, y = make_exclusive_sparse()
+    cfg = Config.from_params({"min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    assert not ds.efb_trivial
+    path = str(tmp_path / "ds.bin")
+    ds.save_binary(path)
+    back = BinnedDataset.load_binary(path)
+    assert not back.efb_trivial
+    np.testing.assert_array_equal(back.bins, ds.bins)
+    np.testing.assert_array_equal(back.bundles.group_of, ds.bundles.group_of)
+    np.testing.assert_array_equal(back.feature_bins(), ds.feature_bins())
+
+
+def test_subset_keeps_bundles():
+    X, y = make_exclusive_sparse()
+    d = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5},
+                    free_raw_data=False)
+    d.construct()
+    sub = d.subset(np.arange(0, 300)).construct()
+    assert sub._handle.bins.shape[1] == d._handle.bins.shape[1]
+    np.testing.assert_array_equal(sub._handle.bins, d._handle.bins[:300])

@@ -1,0 +1,88 @@
+"""Monotone constraint methods: basic vs intermediate vs penalty
+(reference: monotone_constraints.hpp; behavioral oracle mirrors the
+reference test_engine.py monotone slope checks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_mono_data(n=2000, seed=13):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3)
+    y = (3 * X[:, 0] - 2 * X[:, 1] + 0.5 * np.sin(8 * X[:, 2])
+         + rng.randn(n) * 0.02)
+    return X, y
+
+
+def is_monotone_on_grid(bst, feature, sign, others=0.5, tol=1e-10):
+    grid = np.full((60, 3), others)
+    grid[:, feature] = np.linspace(0, 1, 60)
+    p = bst.predict(grid)
+    d = np.diff(p)
+    return np.all(sign * d >= -tol)
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+def test_monotone_methods_enforce_slopes(method):
+    X, y = make_mono_data()
+    params = {"objective": "regression", "verbose": -1,
+              "min_data_in_leaf": 20, "num_leaves": 31,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": method}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=25,
+                    verbose_eval=False)
+    assert is_monotone_on_grid(bst, 0, +1)
+    assert is_monotone_on_grid(bst, 1, -1)
+    # the free feature must still be used (model not degenerate)
+    imp = bst.feature_importance()
+    assert imp[2] > 0
+
+
+def test_intermediate_at_least_as_accurate_as_basic():
+    """The reference's selling point for 'intermediate': less constraint
+    slack => typically better fit. Allow equality wiggle but catch
+    regressions where intermediate breaks the model."""
+    X, y = make_mono_data()
+    base = {"objective": "regression", "verbose": -1,
+            "min_data_in_leaf": 20, "num_leaves": 31, "metric": "l2",
+            "monotone_constraints": [1, -1, 0]}
+    out = {}
+    for method in ("basic", "intermediate"):
+        bst = lgb.train(dict(base, monotone_constraints_method=method),
+                        lgb.Dataset(X, label=y), num_boost_round=30,
+                        verbose_eval=False)
+        out[method] = np.mean((bst.predict(X) - y) ** 2)
+    assert out["intermediate"] <= out["basic"] * 1.10
+
+
+def test_monotone_penalty_suppresses_shallow_monotone_splits():
+    """monotone_penalty=p multiplies monotone-feature gains by ~eps at
+    depths < p (ComputeMonotoneSplitGainPenalty) — the reference's
+    behavioral contract is that the constrained feature cannot be the
+    root split while a free feature has gain."""
+    X, y = make_mono_data()
+    base = {"objective": "regression", "verbose": -1,
+            "min_data_in_leaf": 20, "num_leaves": 31,
+            "monotone_constraints": [1, 0, 0]}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                   num_boost_round=3, verbose_eval=False)
+    b1 = lgb.train(dict(base, monotone_penalty=2.0),
+                   lgb.Dataset(X, label=y), num_boost_round=3,
+                   verbose_eval=False)
+    # unpenalized: the dominant monotone feature wins the root
+    assert any(t.split_feature[0] == 0 for t in b0._gbdt.models)
+    # penalized: never at the root (depth 0 < penalty)
+    assert all(t.split_feature[0] != 0 for t in b1._gbdt.models)
+    assert is_monotone_on_grid(b1, 0, +1)
+
+
+def test_unknown_method_still_trains():
+    X, y = make_mono_data(500)
+    params = {"objective": "regression", "verbose": -1,
+              "min_data_in_leaf": 20,
+              "monotone_constraints": [1, 0, 0],
+              "monotone_constraints_method": "advanced"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False)
+    assert is_monotone_on_grid(bst, 0, +1)

@@ -1,0 +1,108 @@
+"""Packed-forest inference: parity with per-tree traversal, prediction
+early stopping semantics, single-row fast path."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    rng = np.random.RandomState(7)
+    X = rng.randn(800, 10)
+    X[rng.rand(*X.shape) < 0.05] = np.nan  # exercise missing routing
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=30, verbose_eval=False)
+    return bst, X, y
+
+
+def _per_tree_raw(gbdt, x):
+    """Oracle: the original one-dispatch-per-tree accumulation."""
+    import jax.numpy as jnp
+    x = jnp.asarray(np.asarray(x, np.float32))
+    k = gbdt.num_tree_per_iteration
+    score = np.zeros((k, x.shape[0]))
+    gbdt._materialize_models()
+    for i, tree in enumerate(gbdt.models):
+        leaf = np.asarray(tree.leaf_index_raw(x))
+        score[i % k] += np.asarray(tree.leaf_value[:tree.num_leaves])[leaf]
+    return score[0] if k == 1 else score.T
+
+
+def test_packed_forest_matches_per_tree(binary_model):
+    bst, X, _ = binary_model
+    packed = bst.predict(X[:200], raw_score=True)
+    oracle = _per_tree_raw(bst._gbdt, X[:200])
+    np.testing.assert_allclose(packed, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_leaf_indices_match(binary_model):
+    bst, X, _ = binary_model
+    leaves = bst.predict(X[:64], pred_leaf=True)
+    import jax.numpy as jnp
+    xd = jnp.asarray(X[:64].astype(np.float32))
+    for i in (0, 7, 29):
+        tree = bst._gbdt.models[i]
+        np.testing.assert_array_equal(leaves[:, i],
+                                      np.asarray(tree.leaf_index_raw(xd)))
+
+
+def test_single_row_predict(binary_model):
+    bst, X, _ = binary_model
+    full = bst.predict(X[:32])
+    for i in (0, 5, 31):
+        one = bst.predict(X[i:i + 1])
+        assert one.shape == (1,)
+        np.testing.assert_allclose(one[0], full[i], rtol=1e-6)
+
+
+def test_early_stop_huge_margin_is_exact(binary_model):
+    bst, X, _ = binary_model
+    base = bst.predict(X[:128], raw_score=True)
+    gbdt = bst._gbdt
+    gbdt.config.pred_early_stop = True
+    gbdt.config.pred_early_stop_margin = 1e30  # never triggers
+    try:
+        es = bst.predict(X[:128], raw_score=True)
+    finally:
+        gbdt.config.pred_early_stop = False
+    np.testing.assert_allclose(es, base, rtol=1e-6)
+
+
+def test_early_stop_small_margin_partial_sums(binary_model):
+    bst, X, _ = binary_model
+    base = bst.predict(X[:128], raw_score=True)
+    gbdt = bst._gbdt
+    gbdt.config.pred_early_stop = True
+    gbdt.config.pred_early_stop_freq = 5
+    gbdt.config.pred_early_stop_margin = 0.2
+    try:
+        es = bst.predict(X[:128], raw_score=True)
+    finally:
+        gbdt.config.pred_early_stop = False
+        gbdt.config.pred_early_stop_margin = 10.0
+    assert np.all(np.isfinite(es))
+    # margin-stopped rows carry partial sums: 2|s| must exceed the
+    # threshold where stopping happened, and class decisions must agree
+    # with the full model on confidently-classified rows
+    confident = np.abs(base) > 0.5
+    assert np.mean(np.sign(es[confident]) == np.sign(base[confident])) > 0.98
+
+
+def test_early_stop_multiclass():
+    rng = np.random.RandomState(11)
+    X = rng.randn(600, 8)
+    y = (X[:, 0] > 0.3).astype(int) + (X[:, 1] > 0.3).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbose": -1, "min_data_in_leaf": 5,
+                     "pred_early_stop": True, "pred_early_stop_freq": 2,
+                     "pred_early_stop_margin": 1e30},
+                    lgb.Dataset(X, label=y.astype(float)),
+                    num_boost_round=10, verbose_eval=False)
+    es = bst.predict(X[:64], raw_score=True)
+    bst._gbdt.config.pred_early_stop = False
+    base = bst.predict(X[:64], raw_score=True)
+    np.testing.assert_allclose(es, base, rtol=1e-6)
+    assert es.shape == (64, 3)
