@@ -116,6 +116,8 @@ def main():
         "verbose": -1,
         "min_data_in_leaf": 20,
     }
+    if os.environ.get("BENCH_HIST_DTYPE"):
+        params["tpu_hist_dtype"] = os.environ["BENCH_HIST_DTYPE"]
     ds = lgb.Dataset(X, label=y)
 
     # first iteration on the SAME booster/shapes pays the compile

@@ -458,7 +458,12 @@ class Config:
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
     tpu_mesh_shape: List[int] = field(default_factory=list)
-    tpu_hist_dtype: str = "float32"
+    # histogram matmul input dtype: "bfloat16" (default; 2x MXU rate,
+    # grad/hess rounded to 8-bit mantissa — the reference GPU learner's
+    # gpu_use_dp=false single-precision analogue, AUC-neutral) or
+    # "float32" (exact inputs; accumulation is always f32 either way).
+    # Validated in __post_init__.
+    tpu_hist_dtype: str = "bfloat16"
     tpu_rows_per_chunk: int = 0  # 0 = auto
     num_gpu: int = 1
 
@@ -539,6 +544,9 @@ class Config:
 
     def _finalize(self) -> None:
         """Inter-parameter checks (reference Config::CheckParamConflict)."""
+        if self.tpu_hist_dtype not in ("bfloat16", "float32"):
+            log.fatal("tpu_hist_dtype must be 'bfloat16' or 'float32', "
+                      "got %r", self.tpu_hist_dtype)
         self.objective = _resolve_objective_name(self.objective)
         self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
                          "goss": "goss", "rf": "rf",

@@ -51,6 +51,12 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     Returns the list of bin upper bounds; the last bound is +inf.
     """
     assert max_bin > 0
+    if len(distinct_values) > 256:  # native pays off past trivial sizes
+        from ..native import greedy_find_bin_native
+        out = greedy_find_bin_native(distinct_values, counts, max_bin,
+                                     total_cnt, min_data_in_bin)
+        if out is not None:
+            return out
     num_distinct = len(distinct_values)
     bin_upper_bound: List[float] = []
     if num_distinct <= max_bin:
@@ -262,13 +268,15 @@ class BinMapper:
                 bounds.append(math.nan)
             self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
             self.num_bin = len(bounds)
-            # count per bin
-            cnt_in_bin = [0] * self.num_bin
-            i_bin = 0
-            for i in range(len(dv)):
-                while dv[i] > self.bin_upper_bound[i_bin]:
-                    i_bin += 1
-                cnt_in_bin[i_bin] += int(cnts[i])
+            # count per bin: first bound >= value (vectorized form of the
+            # reference's sequential walk; NaN sentinel bound sorts last
+            # and finite values never reach it)
+            n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN
+                                       else 0)
+            bin_of_dv = np.searchsorted(self.bin_upper_bound[:n_search], dv,
+                                        side="left")
+            cnt_in_bin = np.bincount(bin_of_dv, weights=cnts,
+                                     minlength=self.num_bin).astype(np.int64)
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[self.num_bin - 1] = na_cnt
             assert self.num_bin <= max_bin
@@ -344,6 +352,15 @@ class BinMapper:
             # non-NaN-missing-type: NaN treated as 0.0 (reference bin.h:462-466)
             safe = np.where(nan_mask, 0.0, values)
             n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            if len(values) > 4096:
+                from ..native import values_to_bins_native
+                out = values_to_bins_native(safe,
+                                            self.bin_upper_bound[:n_search])
+                if out is not None:
+                    out = out.astype(np.int64)
+                    if self.missing_type == MISSING_NAN:
+                        out = np.where(nan_mask, self.num_bin - 1, out)
+                    return out
             # smallest j with value <= upper[j]; last searched bound is +inf
             out = np.searchsorted(self.bin_upper_bound[:n_search], safe, side="left")
             out = np.minimum(out, n_search - 1)

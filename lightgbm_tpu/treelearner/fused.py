@@ -82,7 +82,7 @@ def fused_supported(config: Config, dataset: BinnedDataset,
 
 class FusedTreeState(NamedTuple):
     """Loop-carried device state; [L] = num_leaves slots."""
-    perm: jax.Array            # [N]
+    data: jax.Array            # [N, W] leaf-ordered packed rows (u8)
     n_leaves: jax.Array        # scalar i32
     leaf_start: jax.Array      # [L]
     leaf_count: jax.Array      # [L]
@@ -158,6 +158,39 @@ class FusedSerialGrower:
         self._efb_dev = dataset.device_bundle_tables()
         self._efb_hist = dataset.device_hist_tables()
         self.group_max_bin = dataset.group_max_bins
+        # TPU: the pallas NT-radix kernel; bfloat16 inputs are the
+        # default (the reference GPU learner's single-precision
+        # histograms, gpu_use_dp=false — AUC-neutral, 2x MXU rate).
+        # Other backends keep the scatter path (exact oracle).
+        if jax.default_backend() == "tpu":
+            self._hist_method = ("radix_pallas"
+                                 if config.tpu_hist_dtype == "float32"
+                                 else "radix_pallas_bf16")
+        else:
+            self._hist_method = None
+        # leaf-ordered packed row layout: [G*cb bin-code bytes | 8 bytes
+        # f32 (grad, hess) | 4 bytes i32 original row id]. TPU random
+        # row gathers/scatters run at ~10ns/row regardless of width, so
+        # the whole training row travels as ONE descriptor during the
+        # partition scatter and every histogram READ is a contiguous
+        # dynamic_slice at HBM speed (see _split_step).
+        self._num_cols = int(self.bins.shape[1])
+        self._code_bytes = int(np.dtype(self.bins.dtype).itemsize)
+        self._row_width = self._num_cols * self._code_bytes + 12
+        self._code_bytes_dev = None  # built lazily on first grow
+        # histogram_pool_size (MB; <=0 unlimited — reference
+        # feature_histogram.hpp:1061 HistogramPool): when the dense
+        # [L, F, B, 2] pool would not fit, run pool-less — both
+        # children's histograms are computed directly (no subtraction),
+        # nothing is cached, memory is O(F*B) instead of O(L*F*B)
+        pool_mb = config.histogram_pool_size
+        need = (self.num_leaves * self.num_features
+                * self.max_num_bin * 2 * 4)
+        self._use_hist_pool = pool_mb <= 0 or need <= pool_mb * 1024 * 1024
+        if not self._use_hist_pool:
+            log.info("histogram pool (%.0f MB) exceeds histogram_pool_size"
+                     "=%.0f MB: disabling histogram subtraction",
+                     need / 1e6, pool_mb)
 
         # score updates can reuse the partition's leaf assignment only
         # when every scored row is in-bag (no bagging/GOSS/RF); with
@@ -185,62 +218,182 @@ class FusedSerialGrower:
         while c < n:
             self._caps.append(c)
             c *= 4
-        self._caps.append(c)
+        # top bucket is exactly n: the next power of four would pad the
+        # root splits by up to 1.6x (measured 10.5M -> 16.7M at HIGGS)
+        self._caps.append(n)
         self._grow_jit = jax.jit(self._grow_tree,
                                  static_argnames=("compute_score_update",))
 
     # ------------------------------------------------------------------
-    def _leaf_hist_switch(self, perm, start, count, grad, hess):
-        """Histogram of a leaf window with dynamic cost: lax.switch over
-        power-of-two capacity buckets (the static-shape answer to the
-        reference's exact-size ordered-gradient gathers). With EFB the
-        histogram runs over G << F bundle columns and is gathered back
-        to per-feature space (FixHistogram mfb reconstruction)."""
-        B = self.max_num_bin
-        Bg = self.group_max_bin
-        efb_hist = self._efb_hist
-
-        def branch(cap):
-            def fn(perm, start, count, grad, hess):
-                if efb_hist is None:
-                    return H.leaf_histogram(self.bins, perm, start, count,
-                                            grad, hess, cap, B)
-                from ..io.efb import per_feature_hist
-                ghist = H.leaf_histogram(self.bins, perm, start, count,
-                                         grad, hess, cap, Bg)
-                total = ghist[0].sum(axis=0)
-                return per_feature_hist(ghist, efb_hist, total[0], total[1])
-            return fn
-
-        branches = [branch(c) for c in self._caps]
+    def _switch_by_cap(self, count, branches_of_cap, *args):
+        branches = [branches_of_cap(c) for c in self._caps]
         cap_arr = jnp.asarray(self._caps, jnp.int32)
         idx = jnp.searchsorted(cap_arr, jnp.maximum(count, 1))
         idx = jnp.minimum(idx, len(self._caps) - 1)
-        return jax.lax.switch(idx, branches, perm, start, count, grad, hess)
+        return jax.lax.switch(idx, branches, *args)
 
-    def _partition_full(self, perm, start, count, feature, thr, dl, miss_bin,
-                        grad_dummy=None):
-        """Stable partition of one leaf's window, O(capacity) per split
-        (replaces data_partition.hpp's threaded two-way partition).
-        lax.switch over power-of-two capacity buckets keeps the work
-        proportional to the leaf size under static shapes — an O(N)
-        full-permutation variant costs ~80% of tree time at 1M rows."""
-        from ..ops.partition import partition_leaf
+    def _window_hist(self, b, g, h):
+        """Histogram of an already-loaded bin block with masked weights;
+        EFB bundle columns are gathered back to per-feature space
+        (FixHistogram mfb reconstruction)."""
+        if self._efb_hist is None:
+            return H.histogram(b, g, h, self.max_num_bin,
+                               method=self._hist_method)
+        from ..io.efb import per_feature_hist
+        ghist = H.histogram(b, g, h, self.group_max_bin,
+                            method=self._hist_method)
+        total = ghist[0].sum(axis=0)
+        return per_feature_hist(ghist, self._efb_hist, total[0], total[1])
 
+    # -- leaf-ordered packed rows --------------------------------------
+    def code_bytes_dev(self):
+        """[N, G*cb] uint8 bin-code bytes, built once. Passed to the
+        jitted tree builder as an ARGUMENT — a closure capture would
+        embed the full matrix as an HLO constant (294 MB at HIGGS
+        scale, which overflows remote-compile request limits)."""
+        if self._code_bytes_dev is None:
+            b = self.bins
+            if self._code_bytes > 1:
+                b = jax.lax.bitcast_convert_type(b, jnp.uint8).reshape(
+                    b.shape[0], self._num_cols * self._code_bytes)
+            self._code_bytes_dev = b
+        return self._code_bytes_dev
+
+    def _pack_rows(self, codes_bytes, perm0, gh2):
+        """[N, W] uint8 leaf-ordered training rows (bin-code bytes +
+        f32 grad/hess bytes + i32 row-id bytes). Without bagging the
+        initial leaf order IS row order, so the pack is a contiguous
+        concat (no gather); with bagging it costs one row gather per
+        tree instead of one per split."""
+        n = perm0.shape[0]
+        gh_b = jax.lax.bitcast_convert_type(
+            gh2.astype(jnp.float32), jnp.uint8).reshape(n, 8)
+        row_b = jax.lax.bitcast_convert_type(
+            perm0.astype(jnp.int32), jnp.uint8)
+        if self._score_from_partition:  # perm0 == arange
+            return jnp.concatenate([codes_bytes, gh_b, row_b], axis=1)
+        return jnp.concatenate(
+            [codes_bytes[perm0], gh_b[perm0], row_b], axis=1)
+
+    def _unpack_block(self, block):
+        """[cap, W] u8 -> (codes [cap, G] int, gh [cap, 2] f32)."""
+        cap = block.shape[0]
+        G, cb = self._num_cols, self._code_bytes
+        if cb == 1:
+            codes = block[:, :G]
+        else:
+            codes = jax.lax.bitcast_convert_type(
+                block[:, :G * cb].reshape(cap, G, cb), jnp.uint16)
+        gh = jax.lax.bitcast_convert_type(
+            block[:, G * cb:G * cb + 8].reshape(cap, 2, 4), jnp.float32)
+        return codes, gh
+
+    def _row_ids(self, data):
+        return jax.lax.bitcast_convert_type(data[:, -4:], jnp.int32)
+
+    def _read_window(self, data, start, count, cap):
+        """Contiguous [cap, W] window covering [start, start+count);
+        returns (block, valid, read_start)."""
+        n = data.shape[0]
+        start = jnp.asarray(start, jnp.int32)
+        read_start = jnp.minimum(start, max(n - cap, 0))
+        block = jax.lax.dynamic_slice(
+            data, (read_start, 0), (min(cap, n), data.shape[1]))
+        if cap > n:
+            block = jnp.pad(block, ((0, cap - n), (0, 0)))
+        off = start - read_start
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        valid = (pos >= off) & (pos < off + count)
+        return block, valid, read_start
+
+    def _leaf_hist_switch(self, data, start, count):
+        """Histogram of a leaf range: a contiguous slice of the
+        leaf-ordered rows + masked radix matmul — no gather at all."""
         def branch(cap):
-            def fn(perm, start, count, feature, thr, dl, miss_bin):
-                return partition_leaf(self.bins, perm, start, count, feature,
-                                      thr, dl, miss_bin, jnp.bool_(False),
-                                      jnp.zeros(1, jnp.uint32), cap,
-                                      efb=self._efb_dev)
+            def fn(data, start, count):
+                block, valid, _ = self._read_window(data, start, count, cap)
+                codes, gh = self._unpack_block(block)
+                g = jnp.where(valid, gh[:, 0], 0.0)
+                h = jnp.where(valid, gh[:, 1], 0.0)
+                return self._window_hist(codes, g, h)
             return fn
 
-        branches = [branch(c) for c in self._caps]
-        cap_arr = jnp.asarray(self._caps, jnp.int32)
-        idx = jnp.searchsorted(cap_arr, jnp.maximum(count, 1))
-        idx = jnp.minimum(idx, len(self._caps) - 1)
-        return jax.lax.switch(idx, branches, perm, start, count, feature,
-                              thr, dl, miss_bin)
+        return self._switch_by_cap(count, branch, data, start, count)
+
+    def _split_step(self, data, start, count, feature, thr, dl, miss_bin):
+        """Split one leaf: ONE contiguous read of its row block, the
+        routing decision, a single row-scatter writing the partitioned
+        block back, and the smaller child's histogram from the same
+        block. This is the TPU answer to DataPartition::Split +
+        ConstructHistograms: random access is concentrated in one
+        in-window row scatter (~10ns/row); everything else is
+        slice-contiguous. Returns (data, nleft, hist_smaller)."""
+        efb = self._efb_dev
+
+        def branch(cap):
+            def fn(data, start, count, feature, thr, dl, miss_bin):
+                n = data.shape[0]
+                block, valid, read_start = self._read_window(
+                    data, start, count, cap)
+                codes, gh = self._unpack_block(block)
+
+                # --- routing on the split column. The column pick is a
+                # one-hot matmul, NOT take_along_axis: a traced column
+                # index lowers to a per-row gather (~7ns/row — measured
+                # as the single hottest op of the old split step) while
+                # the [cap, G] @ [G] product rides the MXU for free ---
+                gidx = efb[0][feature] if efb is not None else feature
+                sel = (jnp.arange(codes.shape[1]) == gidx).astype(jnp.float32)
+                col = jnp.einsum(
+                    "rg,g->r", codes.astype(jnp.float32), sel,
+                    precision="highest").astype(jnp.int32)
+                if efb is not None:
+                    from ..io.efb import decode_bins
+                    binval = decode_bins(col, feature, efb)
+                else:
+                    binval = col
+                from ..ops.partition import _decision_go_left
+                go_left = _decision_go_left(binval, thr, dl, miss_bin,
+                                            jnp.bool_(False))
+
+                # --- stable partition via cumsum ranks + row scatter ---
+                from ..ops.partition import cumsum_1d
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                off = jnp.asarray(start, jnp.int32) - read_start
+                gl = go_left & valid
+                gr = (~go_left) & valid
+                nleft = jnp.sum(gl).astype(jnp.int32)
+                rank_l = cumsum_1d(gl.astype(jnp.int32)) - 1
+                rank_r = cumsum_1d(gr.astype(jnp.int32)) - 1
+                new_pos = jnp.where(
+                    gl, off + rank_l,
+                    jnp.where(gr, off + nleft + rank_r, pos)).astype(jnp.int32)
+                # invert the permutation with a 4-byte scatter, then move
+                # the 40-byte rows with a gather: TPU row scatters
+                # degrade ~15x beyond ~2M-row tables, gathers less so
+                inv = jnp.zeros((cap,), jnp.int32).at[new_pos].set(
+                    pos, unique_indices=True)
+                new_block = block[inv]
+                if cap <= n:
+                    data = jax.lax.dynamic_update_slice(
+                        data, new_block, (read_start, 0))
+                else:
+                    data = jax.lax.dynamic_update_slice(
+                        data, new_block[:n], (0, 0))
+
+                return data, nleft
+            return fn
+
+        data, nleft = self._switch_by_cap(count, branch, data, start, count,
+                                          feature, thr, dl, miss_bin)
+        # smaller child's histogram at ITS OWN capacity bucket — the
+        # post-partition child range is a contiguous slice, and the
+        # pallas matmul volume halves vs histogramming the parent block
+        left_smaller = nleft <= count - nleft
+        s_start = jnp.where(left_smaller, start, start + nleft)
+        s_count = jnp.where(left_smaller, nleft, count - nleft)
+        hist_small = self._leaf_hist_switch(data, s_start, s_count)
+        return data, nleft, hist_small
 
     def _scan_leaf(self, hist, sum_g, sum_h, count, output, cmin, cmax,
                    feature_mask):
@@ -262,8 +415,21 @@ class FusedSerialGrower:
             rg=res["right_sum_gradient"][f], rh=res["right_sum_hessian"][f],
             rcnt=res["right_count"][f], rout=res["right_output"][f])
 
+    def _scan_two_leaves(self, hist2, sum_g2, sum_h2, count2, output2,
+                         cmin2, cmax2, feature_mask):
+        """Both children's best splits from one vmapped scan (halves the
+        per-split scan kernel count vs two sequential _scan_leaf calls)."""
+        res2 = jax.vmap(
+            lambda h, sg, sh, c, o, lo, hi: self._scan_leaf(
+                h, sg, sh, c, o, lo, hi, feature_mask)
+        )(hist2, sum_g2, sum_h2, count2, output2, cmin2, cmax2)
+        first = {k: v[0] for k, v in res2.items()}
+        second = {k: v[1] for k, v in res2.items()}
+        return first, second
+
     # ------------------------------------------------------------------
-    def _grow_tree(self, grad, hess, perm0, bag_cnt, feature_mask,
+    def _grow_tree(self, codes_bytes, grad, hess, perm0, bag_cnt,
+                   feature_mask,
                    compute_score_update: bool = True):
         """The single-dispatch tree builder. Returns (tree arrays dict,
         leaf_value_update [N] or None)."""
@@ -271,9 +437,10 @@ class FusedSerialGrower:
         F, B = self.num_features, self.max_num_bin
         n = perm0.shape[0]
         f32, i32 = jnp.float32, jnp.int32
+        gh2 = jnp.stack([grad, hess], axis=1)
+        data0 = self._pack_rows(codes_bytes, perm0, gh2)
 
-        root_hist = self._leaf_hist_switch(perm0, jnp.int32(0), bag_cnt,
-                                           grad, hess)
+        root_hist = self._leaf_hist_switch(data0, jnp.int32(0), bag_cnt)
         sum_g = jnp.sum(root_hist[0, :, 0])
         sum_h = jnp.sum(root_hist[0, :, 1])
         root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt,
@@ -284,7 +451,7 @@ class FusedSerialGrower:
             return jnp.full((L,), val, dtype)
 
         st = FusedTreeState(
-            perm=perm0, n_leaves=i32(1),
+            data=data0, n_leaves=i32(1),
             leaf_start=arr(0, i32).at[0].set(0),
             leaf_count=arr(0, i32).at[0].set(bag_cnt),
             leaf_sum_g=arr(0.0).at[0].set(sum_g),
@@ -305,7 +472,9 @@ class FusedSerialGrower:
             best_rh=arr(0.0).at[0].set(root_best["rh"]),
             best_rcnt=arr(0, i32).at[0].set(root_best["rcnt"]),
             best_rout=arr(0.0).at[0].set(root_best["rout"]),
-            hist_pool=jnp.zeros((L, F, B, 2), f32).at[0].set(root_hist),
+            hist_pool=(jnp.zeros((L, F, B, 2), f32).at[0].set(root_hist)
+                       if self._use_hist_pool
+                       else jnp.zeros((1, 1, 1, 2), f32)),
             t_feature=jnp.zeros((L - 1,), i32),
             t_thr=jnp.zeros((L - 1,), i32),
             t_dl=jnp.zeros((L - 1,), bool),
@@ -359,11 +528,11 @@ class FusedSerialGrower:
             t_iweight = st.t_iweight.at[node].set(st.leaf_sum_h[leaf])
             t_icount = st.t_icount.at[node].set(st.leaf_count[leaf])
 
-            # --- partition ---
+            # --- partition + smaller-child histogram (one block) ---
             start = st.leaf_start[leaf]
             count = st.leaf_count[leaf]
-            new_perm, nleft = self._partition_full(st.perm, start, count,
-                                                   feat, thr, dl, miss)
+            new_data, nleft, hist_small = self._split_step(
+                st.data, start, count, feat, thr, dl, miss)
             nright = count - nleft
 
             # --- children bookkeeping ---
@@ -396,29 +565,39 @@ class FusedSerialGrower:
             leaf_cmin = st.leaf_cmin.at[leaf].set(lcmin).at[new_leaf].set(rcmin)
             leaf_cmax = st.leaf_cmax.at[leaf].set(lcmax).at[new_leaf].set(rcmax)
 
-            # --- histograms: smaller child gathered, larger subtracted ---
+            # --- larger child: subtraction from the pooled parent (or a
+            # second contiguous-slice histogram when pool-less) ---
             left_smaller = nleft <= nright
-            s_start = jnp.where(left_smaller, start, start + nleft)
-            s_count = jnp.where(left_smaller, nleft, nright)
-            hist_small = self._leaf_hist_switch(new_perm, s_start, s_count,
-                                                grad, hess)
-            hist_large = st.hist_pool[leaf] - hist_small
-            hist_left = jnp.where(left_smaller, hist_small, hist_large)
-            hist_right = jnp.where(left_smaller, hist_large, hist_small)
-            hist_pool = st.hist_pool.at[leaf].set(hist_left)\
-                                    .at[new_leaf].set(hist_right)
+            if self._use_hist_pool:
+                hist_large = st.hist_pool[leaf] - hist_small
+                hist_left = jnp.where(left_smaller, hist_small, hist_large)
+                hist_right = jnp.where(left_smaller, hist_large, hist_small)
+                hist_pool = st.hist_pool.at[leaf].set(hist_left)\
+                                        .at[new_leaf].set(hist_right)
+            else:
+                l_start = jnp.where(left_smaller, start + nleft, start)
+                l_count = jnp.where(left_smaller, nright, nleft)
+                hist_large = self._leaf_hist_switch(new_data, l_start,
+                                                    l_count)
+                hist_left = jnp.where(left_smaller, hist_small, hist_large)
+                hist_right = jnp.where(left_smaller, hist_large, hist_small)
+                hist_pool = st.hist_pool
 
-            # --- best splits for both children ---
-            bl = self._scan_leaf(hist_left, st.best_lg[leaf], st.best_lh[leaf],
-                                 nleft, lout, lcmin, lcmax, feature_mask)
-            br = self._scan_leaf(hist_right, st.best_rg[leaf], st.best_rh[leaf],
-                                 nright, rout, rcmin, rcmax, feature_mask)
+            # --- best splits for both children (one vmapped scan) ---
+            bl, br = self._scan_two_leaves(
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([st.best_lg[leaf], st.best_rg[leaf]]),
+                jnp.stack([st.best_lh[leaf], st.best_rh[leaf]]),
+                jnp.stack([nleft, nright]),
+                jnp.stack([lout, rout]),
+                jnp.stack([lcmin, rcmin]),
+                jnp.stack([lcmax, rcmax]), feature_mask)
 
             def upd(a, key, cast=lambda x: x):
                 return a.at[leaf].set(cast(bl[key])).at[new_leaf].set(cast(br[key]))
 
             return FusedTreeState(
-                perm=new_perm, n_leaves=st.n_leaves + 1,
+                data=new_data, n_leaves=st.n_leaves + 1,
                 leaf_start=leaf_start, leaf_count=leaf_count,
                 leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
                 leaf_output=leaf_output, leaf_depth=leaf_depth,
@@ -458,13 +637,21 @@ class FusedSerialGrower:
                 # the partition already assigned every row to a leaf:
                 # leaf intervals [start, start+count) tile [0, N), so a
                 # searchsorted over the sorted starts + a scatter through
-                # the permutation yields leaf-of-row without re-walking
+                # the row ids yields leaf-of-row without re-walking
                 # the tree (the DataPartition shortcut of the reference's
                 # ScoreUpdater::AddScore, score_updater.hpp:88 — here it
                 # replaces an ~O(depth) gather chain per iteration)
                 leaf_of_row = self._leaf_ids_from_partition(st, n)
             else:
-                leaf_of_row = self._traverse_device(tree_arrays)
+                # bagging: re-walk the tree over the ROW-ORDERED bins,
+                # reconstructed from the code bytes arg (a self.bins
+                # closure would embed the matrix as an HLO constant)
+                bins_mat = codes_bytes
+                if self._code_bytes > 1:
+                    bins_mat = jax.lax.bitcast_convert_type(
+                        codes_bytes.reshape(n, self._num_cols,
+                                            self._code_bytes), jnp.uint16)
+                leaf_of_row = self.traverse_bins(tree_arrays, bins_mat)
         return tree_arrays, leaf_of_row
 
     def _leaf_ids_from_partition(self, st: FusedTreeState, n: int):
@@ -477,7 +664,8 @@ class FusedSerialGrower:
         pos = jnp.arange(n, dtype=jnp.int32)
         k = jnp.searchsorted(sorted_starts, pos, side="right") - 1
         pos_leaf = order[jnp.maximum(k, 0)]
-        return jnp.zeros(n, jnp.int32).at[st.perm].set(pos_leaf,
+        row_ids = self._row_ids(st.data)
+        return jnp.zeros(n, jnp.int32).at[row_ids].set(pos_leaf,
                                                        unique_indices=True)
 
     def _traverse_device(self, ta) -> jax.Array:
@@ -538,8 +726,8 @@ class FusedSerialGrower:
     def grow_device(self, grad, hess, perm, bag_cnt,
                     compute_score_update=True):
         """Returns (tree_arrays dict of device arrays, leaf_of_row)."""
-        return self._grow_jit(grad, hess, perm, jnp.int32(bag_cnt),
-                              self.feature_mask_tree(),
+        return self._grow_jit(self.code_bytes_dev(), grad, hess, perm,
+                              jnp.int32(bag_cnt), self.feature_mask_tree(),
                               compute_score_update=compute_score_update)
 
     @functools.partial(jax.jit, static_argnums=0)

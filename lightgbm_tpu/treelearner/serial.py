@@ -123,6 +123,21 @@ class SerialTreeGrower:
             or bool(config.cegb_penalty_feature_coupled)
             or bool(config.cegb_penalty_feature_lazy))
         self._cegb_coupled_used = np.zeros(self.num_features, dtype=bool)
+        # histogram_pool_size (MB; <=0 unlimited; reference
+        # feature_histogram.hpp:1061): when the per-leaf histogram set
+        # would not fit, drop leaf histograms after their best-split
+        # scan and recompute on demand (no subtraction)
+        pool_mb = config.histogram_pool_size
+        need = (config.num_leaves * self.num_features
+                * self.max_num_bin * 2 * 4)
+        self._keep_hists = pool_mb <= 0 or need <= pool_mb * 1024 * 1024
+        if not self._keep_hists:
+            log.info("histogram pool (%.0f MB) exceeds histogram_pool_size"
+                     "=%.0f MB: recomputing leaf histograms on demand",
+                     need / 1e6, pool_mb)
+        self._cur_perm = None
+        self._cur_grad = None
+        self._cur_hess = None
 
     # ------------------------------------------------------------------
     def _split_packed(self, hist, sum_g, sum_h, num_data, parent_output,
@@ -165,17 +180,25 @@ class SerialTreeGrower:
         B = self.max_num_bin
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
+        # TPU: pallas radix (dtype per config); other backends keep the
+        # exact scatter path regardless of tpu_hist_dtype
+        if jax.default_backend() == "tpu":
+            method = ("radix_pallas"
+                      if self.config.tpu_hist_dtype == "float32"
+                      else "radix_pallas_bf16")
+        else:
+            method = None
 
         @jax.jit
         def fn(bins, perm, start, count, grad, hess):
             if efb_hist is None:
                 return H.leaf_histogram(bins, perm, start, count, grad, hess,
-                                        capacity, B)
+                                        capacity, B, method=method)
             # bundle-space histogram over G << F columns, then gather to
             # per-feature space with FixHistogram mfb reconstruction
             from ..io.efb import per_feature_hist
             ghist = H.leaf_histogram(bins, perm, start, count, grad, hess,
-                                     capacity, Bg)
+                                     capacity, Bg, method=method)
             total = ghist[0].sum(axis=0)  # every row in exactly one code
             return per_feature_hist(ghist, efb_hist, total[0], total[1])
         return fn
@@ -275,6 +298,7 @@ class SerialTreeGrower:
                 cfg.monotone_constraints_method, cfg.num_leaves,
                 self._monotone_np)
 
+        self._cur_perm, self._cur_grad, self._cur_hess = perm, grad, hess
         root = _Leaf(0, num_data, 0.0, 0.0, 0.0, 0)
         cap = next_capacity(num_data)
         root.hist = self._hist_fn(cap)(self.bins, perm, 0, num_data, grad, hess)
@@ -290,6 +314,8 @@ class SerialTreeGrower:
             leaf.best = self._compute_best(
                 leaf, tree_mask, set() if self._interaction_sets else None,
                 rand_thr)
+            if not self._keep_hists:
+                leaf.hist = None
 
         for _ in range(cfg.num_leaves - 1 - tree.num_nodes):
             # pick the globally-best leaf (reference ArgMax at :188)
@@ -316,6 +342,16 @@ class SerialTreeGrower:
         if leaf.count < 2 * self.config.min_data_in_leaf \
                 or leaf.sum_h < 2 * self.config.min_sum_hessian_in_leaf:
             return None
+        drop_after = False
+        if leaf.hist is None:
+            # pool-capped mode: recompute this leaf's histogram from its
+            # still-valid permutation window (reference HistogramPool
+            # miss -> reconstruct)
+            cap = next_capacity(leaf.count)
+            leaf.hist = self._hist_fn(cap)(
+                self.bins, self._cur_perm, jnp.int32(leaf.start),
+                jnp.int32(leaf.count), self._cur_grad, self._cur_hess)
+            drop_after = True
         mask = self._feature_mask_node(tree_mask, branch_features)
         cegb = self._cegb_delta(leaf)
         scale = None
@@ -333,6 +369,8 @@ class SerialTreeGrower:
             else jnp.zeros(self.num_features, jnp.int32), cegb, scale)
         v = np.asarray(vec, dtype=np.float64)
         iv = np.asarray(ivec, dtype=np.int64)
+        if drop_after:
+            leaf.hist = None
         if not iv[5] or not np.isfinite(v[0]) or v[0] <= 0.0:
             return None
         best = {
@@ -421,13 +459,21 @@ class SerialTreeGrower:
                       leaf.depth + 1, cmin=rcmin, cmax=rcmax)
 
         # histogram: smaller child directly, larger by subtraction
-        # (reference serial_tree_learner.cpp:396-404)
+        # (reference serial_tree_learner.cpp:396-404); pool-capped mode
+        # computes both directly and keeps nothing
+        self._cur_perm = new_perm
         smaller, larger = (left, right) if lc <= rc else (right, left)
         scap = next_capacity(max(smaller.count, 1))
         smaller.hist = self._hist_fn(scap)(
             self.bins, new_perm, jnp.int32(smaller.start),
             jnp.int32(smaller.count), grad, hess)
-        larger.hist = leaf.hist - smaller.hist
+        if self._keep_hists and leaf.hist is not None:
+            larger.hist = leaf.hist - smaller.hist
+        else:
+            lcap = next_capacity(max(larger.count, 1))
+            larger.hist = self._hist_fn(lcap)(
+                self.bins, new_perm, jnp.int32(larger.start),
+                jnp.int32(larger.count), grad, hess)
         leaf.hist = None
 
         branches = None
@@ -439,6 +485,9 @@ class SerialTreeGrower:
                         if f in self.dataset.inner_feature_index}
         left.best = self._compute_best(left, tree_mask, branches, rand_thr)
         right.best = self._compute_best(right, tree_mask, branches, rand_thr)
+        if not self._keep_hists:
+            left.hist = None
+            right.hist = None
 
         leaves[lid] = left
         leaves[right_leaf] = right
@@ -483,6 +532,11 @@ class SerialTreeGrower:
             mapper = self.dataset.bin_mappers[inner]
             thr_bin = int(mapper.value_to_bin(float(node["threshold"])))
             thr_bin = max(0, min(thr_bin, mapper.num_bin - 2))
+            if leaf.hist is None:  # pool-capped mode dropped it
+                cap = next_capacity(max(leaf.count, 1))
+                leaf.hist = self._hist_fn(cap)(
+                    self.bins, perm, jnp.int32(leaf.start),
+                    jnp.int32(leaf.count), grad, hess)
             hist = np.asarray(leaf.hist[inner], dtype=np.float64)  # [B, 2]
             miss = int(self.feature_miss_bin[inner])
             sel = np.arange(hist.shape[0]) <= thr_bin

@@ -1,0 +1,65 @@
+"""histogram_pool_size cap: pool-less / recompute modes must train the
+same model as the unlimited pool (reference HistogramPool LRU,
+feature_histogram.hpp:1061 — here the cap switches off subtraction and
+caching instead of evicting)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=1500, f=40, seed=9):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def test_pool_cap_matches_unlimited_fused():
+    X, y = make_data()
+    base = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
+            "num_leaves": 31}
+    b_full = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                       num_boost_round=8, verbose_eval=False)
+    # 31*40*256*2*4B ~= 2.5 MB -> 1 MB cap forces pool-less mode
+    b_cap = lgb.train(dict(base, histogram_pool_size=1),
+                      lgb.Dataset(X, label=y),
+                      num_boost_round=8, verbose_eval=False)
+    assert not b_cap._gbdt._fused._use_hist_pool
+    assert b_full._gbdt._fused._use_hist_pool
+    np.testing.assert_allclose(b_cap.predict(X), b_full.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pool_cap_matches_unlimited_serial():
+    X, y = make_data()
+    # categorical feature forces the host-loop serial grower
+    Xc = X.copy()
+    Xc[:, 3] = np.random.RandomState(1).randint(0, 5, len(X))
+    base = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
+            "num_leaves": 31, "categorical_feature": [3]}
+    b_full = lgb.train(dict(base), lgb.Dataset(Xc, label=y),
+                       num_boost_round=6, verbose_eval=False)
+    b_cap = lgb.train(dict(base, histogram_pool_size=1),
+                      lgb.Dataset(Xc, label=y),
+                      num_boost_round=6, verbose_eval=False)
+    assert b_cap._gbdt._fused is None
+    np.testing.assert_allclose(b_cap.predict(Xc), b_full.predict(Xc),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pool_cap_with_monotone_intermediate():
+    """The intermediate monotone recompute path must survive dropped
+    histograms (on-demand reconstruction)."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(1200, 3)
+    y = 2 * X[:, 0] - X[:, 1] + 0.02 * rng.randn(1200)
+    params = {"objective": "regression", "verbose": -1,
+              "min_data_in_leaf": 20, "num_leaves": 31,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": "intermediate",
+              "histogram_pool_size": 1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False)
+    grid = np.column_stack([np.linspace(0, 1, 50), np.full(50, .5),
+                            np.full(50, .5)])
+    assert np.all(np.diff(bst.predict(grid)) >= -1e-10)

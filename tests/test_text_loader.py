@@ -1,0 +1,107 @@
+"""Robust text parsing (reference parser.cpp/parser.hpp behaviors:
+quoting, NA strings, name:-addressed columns, LibSVM, query groups)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.text_loader import (_detect_format,
+                                         _group_sizes_from_query_ids,
+                                         load_text_file)
+
+
+def test_quoted_fields_and_na_strings(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text('1,"2.5",na,4\n0,NULL,"3.25",5\n1,2.0,N/A,\n')
+    cfg = Config()
+    mat, label, weight, group = load_text_file(str(p), cfg)
+    np.testing.assert_array_equal(label, [1, 0, 1])
+    assert mat.shape == (3, 3)
+    np.testing.assert_allclose(mat[0], [2.5, np.nan, 4], equal_nan=True)
+    np.testing.assert_allclose(mat[1], [np.nan, 3.25, 5], equal_nan=True)
+    assert np.isnan(mat[2, 1]) and np.isnan(mat[2, 2])
+
+
+def test_header_and_named_columns(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("target,w,f1,f2\n1,2.0,3,4\n0,1.0,5,6\n")
+    cfg = Config.from_params({"header": True, "label_column": "name:target",
+                              "weight_column": "name:w"})
+    mat, label, weight, group = load_text_file(str(p), cfg)
+    np.testing.assert_array_equal(label, [1, 0])
+    np.testing.assert_array_equal(weight, [2.0, 1.0])
+    np.testing.assert_array_equal(mat, [[3, 4], [5, 6]])
+
+
+def test_ignore_column(tmp_path):
+    """Integer specs don't count the label column (reference docs:
+    'index starts from 0 and it doesn't count the label column'), so
+    ignore_column=1 with the label at file column 0 drops the SECOND
+    feature = file column 2."""
+    p = tmp_path / "data.csv"
+    p.write_text("1,10,20,30\n0,11,21,31\n")
+    cfg = Config.from_params({"ignore_column": "1"})
+    mat, label, _, _ = load_text_file(str(p), cfg)
+    np.testing.assert_array_equal(mat, [[10, 30], [11, 31]])
+
+
+def test_tsv_detection(tmp_path):
+    p = tmp_path / "data.tsv"
+    p.write_text("1\t2.5\t3\n0\t4.5\t6\n")
+    mat, label, _, _ = load_text_file(str(p), Config())
+    np.testing.assert_array_equal(label, [1, 0])
+    np.testing.assert_array_equal(mat, [[2.5, 3], [4.5, 6]])
+
+
+def test_group_column_query_ids(tmp_path):
+    """group_column=0 = the FIRST non-label column (file column 1)."""
+    p = tmp_path / "data.csv"
+    rows = ["1,%d,0.5" % q for q in (7, 7, 7, 9, 9, 4)]
+    p.write_text("\n".join(rows) + "\n")
+    cfg = Config.from_params({"group_column": "0"})
+    mat, label, _, group = load_text_file(str(p), cfg)
+    np.testing.assert_array_equal(group, [3, 2, 1])
+    assert mat.shape == (6, 1)
+
+
+def test_libsvm_sparse_output(tmp_path):
+    sp = pytest.importorskip("scipy.sparse")
+    p = tmp_path / "data.svm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:4.0\n1 0:0.5 4:1.0\n")
+    mat, label, _, _ = load_text_file(str(p), Config())
+    assert sp.issparse(mat)
+    assert mat.shape == (3, 5)
+    assert mat[0, 3] == 2.0 and mat[2, 4] == 1.0
+    np.testing.assert_array_equal(label, [1, 0, 1])
+
+
+def test_format_detection():
+    assert _detect_format(["1 0:2.5 3:1\n"]) == "libsvm"
+    assert _detect_format(["1,2,3\n"]) == "csv"
+    assert _detect_format(["1\t2\t3\n"]) == "tsv"
+
+
+def test_group_sizes_helper():
+    np.testing.assert_array_equal(
+        _group_sizes_from_query_ids(np.asarray([1, 1, 2, 2, 2, 5])),
+        [2, 3, 1])
+
+
+def test_train_from_csv_end_to_end(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(int)
+    lines = ["%d,%s" % (y[i], ",".join("%.6f" % v for v in X[i]))
+             for i in range(400)]
+    p = tmp_path / "train.csv"
+    p.write_text("\n".join(lines) + "\n")
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 10},
+                    lgb.Dataset(str(p)), num_boost_round=5,
+                    verbose_eval=False)
+    pred = bst.predict(X)
+    auc_order = np.argsort(-pred)
+    yy = y[auc_order] > 0
+    pos, neg = yy.sum(), len(yy) - yy.sum()
+    r = np.arange(1, len(yy) + 1)
+    assert 1.0 - (np.sum(r[yy]) - pos * (pos + 1) / 2) / (pos * neg) > 0.9
