@@ -21,8 +21,11 @@ Robustness contract with the driver:
 - the jit cache persists across processes via
   jax_compilation_cache_dir=.jax_cache, so repeat runs skip compile.
 
-Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 60),
-BENCH_BUDGET_S (default 420), BENCH_LEAVES/BENCH_BIN (default 255).
+Env knobs: BENCH_ROWS (default 4_194_304 — measured per-iteration time
+has a fixed component, so extrapolating from larger row counts is more
+honest; 4M keeps the run inside the driver budget), BENCH_ITERS
+(default 8), BENCH_BUDGET_S (default 420), BENCH_LEAVES/BENCH_BIN
+(default 255).
 """
 import json
 import os
@@ -32,9 +35,9 @@ import time
 
 import numpy as np
 
-ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+ROWS = int(os.environ.get("BENCH_ROWS", 4_194_304))
 COLS = 28
-ITERS = int(os.environ.get("BENCH_ITERS", 60))
+ITERS = int(os.environ.get("BENCH_ITERS", 8))
 LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
 BUDGET = float(os.environ.get("BENCH_BUDGET_S", 420))

@@ -92,31 +92,16 @@ def _resolve_column(spec: str, header_names: Optional[Sequence[str]],
 
 def _resolve_ignore(spec: str, header_names,
                     label_col: Optional[int] = None) -> List[int]:
+    """Comma list of ignore columns through the same resolution as the
+    single-column specs (missing names are fatal, like the reference's
+    DatasetLoader ignore handling; int indices don't count the label)."""
     if not spec:
         return []
-    items = (spec[5:].split(",") if spec.startswith("name:")
-             else spec.split(","))
-    out = []
-    for it in items:
-        it = it.strip()
-        if not it:
-            continue
-        if spec.startswith("name:"):
-            if header_names and it in header_names:
-                out.append(list(header_names).index(it))
-            else:
-                log.warning("ignore_column %s not in header, skipped", it)
-        else:
-            try:
-                idx = int(it)
-            except ValueError:
-                log.fatal("Invalid ignore_column specifier %r (use indices "
-                          "or name:<col>,<col>)", it)
-                continue
-            if label_col is not None and idx >= label_col >= 0:
-                idx += 1  # indices don't count the label column
-            out.append(idx)
-    return out
+    named = spec.startswith("name:")
+    items = (spec[5:] if named else spec).split(",")
+    return [_resolve_column("name:" + it.strip() if named else it.strip(),
+                            header_names, -1, "ignore_column", label_col)
+            for it in items if it.strip()]
 
 
 def _group_sizes_from_query_ids(qids: np.ndarray) -> np.ndarray:

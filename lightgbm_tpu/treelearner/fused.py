@@ -293,14 +293,14 @@ class FusedSerialGrower:
 
     def _read_window(self, data, start, count, cap):
         """Contiguous [cap, W] window covering [start, start+count);
-        returns (block, valid, read_start)."""
+        returns (block, valid, read_start). The capacity ladder tops out
+        at exactly N, so cap <= N always."""
         n = data.shape[0]
+        assert cap <= n, "capacity ladder must top out at num_data"
         start = jnp.asarray(start, jnp.int32)
-        read_start = jnp.minimum(start, max(n - cap, 0))
+        read_start = jnp.minimum(start, n - cap)
         block = jax.lax.dynamic_slice(
-            data, (read_start, 0), (min(cap, n), data.shape[1]))
-        if cap > n:
-            block = jnp.pad(block, ((0, cap - n), (0, 0)))
+            data, (read_start, 0), (cap, data.shape[1]))
         off = start - read_start
         pos = jnp.arange(cap, dtype=jnp.int32)
         valid = (pos >= off) & (pos < off + count)
@@ -356,31 +356,24 @@ class FusedSerialGrower:
                 go_left = _decision_go_left(binval, thr, dl, miss_bin,
                                             jnp.bool_(False))
 
-                # --- stable partition via cumsum ranks + row scatter ---
-                from ..ops.partition import cumsum_1d
+                # --- stable partition: argsort of the 4-way key gives
+                # the inverse permutation directly (pre-window rows
+                # first in original order, then lefts, rights, tail) —
+                # no scatter at all; TPU scatters (even 4-byte ones)
+                # degrade badly beyond ~2M-row tables, sorts don't ---
                 pos = jnp.arange(cap, dtype=jnp.int32)
                 off = jnp.asarray(start, jnp.int32) - read_start
                 gl = go_left & valid
                 gr = (~go_left) & valid
                 nleft = jnp.sum(gl).astype(jnp.int32)
-                rank_l = cumsum_1d(gl.astype(jnp.int32)) - 1
-                rank_r = cumsum_1d(gr.astype(jnp.int32)) - 1
-                new_pos = jnp.where(
-                    gl, off + rank_l,
-                    jnp.where(gr, off + nleft + rank_r, pos)).astype(jnp.int32)
-                # invert the permutation with a 4-byte scatter, then move
-                # the 40-byte rows with a gather: TPU row scatters
-                # degrade ~15x beyond ~2M-row tables, gathers less so
-                inv = jnp.zeros((cap,), jnp.int32).at[new_pos].set(
-                    pos, unique_indices=True)
+                key = jnp.where(pos < off, jnp.int8(0),
+                                jnp.where(gl, jnp.int8(1),
+                                          jnp.where(gr, jnp.int8(2),
+                                                    jnp.int8(3))))
+                inv = jnp.argsort(key, stable=True)
                 new_block = block[inv]
-                if cap <= n:
-                    data = jax.lax.dynamic_update_slice(
-                        data, new_block, (read_start, 0))
-                else:
-                    data = jax.lax.dynamic_update_slice(
-                        data, new_block[:n], (0, 0))
-
+                data = jax.lax.dynamic_update_slice(
+                    data, new_block, (read_start, 0))
                 return data, nleft
             return fn
 
@@ -662,7 +655,11 @@ class FusedSerialGrower:
         order = jnp.argsort(starts)             # tiny: [num_leaves]
         sorted_starts = starts[order]
         pos = jnp.arange(n, dtype=jnp.int32)
-        k = jnp.searchsorted(sorted_starts, pos, side="right") - 1
+        # rank of each position among the sorted starts as a broadcast
+        # compare-and-sum ([N, L] fused on the VPU) — jnp.searchsorted
+        # binary-search gathers cost ~8 passes of per-element access
+        k = jnp.sum(pos[:, None] >= sorted_starts[None, :],
+                    axis=1).astype(jnp.int32) - 1
         pos_leaf = order[jnp.maximum(k, 0)]
         row_ids = self._row_ids(st.data)
         return jnp.zeros(n, jnp.int32).at[row_ids].set(pos_leaf,
