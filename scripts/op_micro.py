@@ -1,8 +1,12 @@
 """Microbenchmark the fused-path component ops on the real device.
 
-Times, per op, at HIGGS-like shapes: radix histogram (f32/bf16),
-scatter histogram, leaf gather, partition (argsort-based), and the
-split scan. Prints a table; run on TPU (no env forcing)."""
+WARNING (see docs/PERF_NOTES.md "tunnel hazards"): per-dispatch host
+timing through the axon tunnel is unreliable — repeated executions
+with identical arguments appear to be served from a cache, XLA
+dead-code-eliminates unconsumed outputs, and dispatch latency varies
+by orders of magnitude. Treat these numbers as smoke only; for real
+attribution use scripts/profile_fused.py (device-side profiler trace)
+or end-to-end bench.py iterations."""
 import time
 
 import numpy as np
