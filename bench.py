@@ -133,13 +133,13 @@ def main():
     # JAX dispatch is async — block on the updated training score so each
     # sample is real device wall-clock, not dispatch latency.
     import jax as _jax
-    _jax.block_until_ready(bst._gbdt.train_score.score)
+    _jax.block_until_ready(bst._gbdt.device_score_state())
     while len(STATE["iter_times"]) < ITERS:
         if time.time() - T0 > BUDGET * 0.75:
             break
         t0 = time.time()
         bst.update()
-        _jax.block_until_ready(bst._gbdt.train_score.score)
+        _jax.block_until_ready(bst._gbdt.device_score_state())
         STATE["iter_times"].append(time.time() - t0)
 
     # measurement is complete; don't let the alarm clip the AUC check

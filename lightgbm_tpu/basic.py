@@ -495,7 +495,7 @@ class Booster:
         return out
 
     def _inner_predict_train(self):
-        score = np.asarray(self._gbdt.train_score.score, dtype=np.float64)
+        score = np.asarray(self._gbdt.get_training_score(), dtype=np.float64)
         return self._conv_eval_scores(score)
 
     def _inner_predict_valid(self, idx):

@@ -106,8 +106,17 @@ class GBDT:
         # remote-accelerator latency; host-loop grower covers the rest
         from ..treelearner.fused import FusedSerialGrower, fused_supported
         self._fused = None
+        self._fused_state = None     # persistent planar state (device)
+        self._score_dirty = False    # train_score stale vs _fused_state
         if fused_supported(config, train_data, objective):
-            self._fused = FusedSerialGrower(train_data, config)
+            self._fused = FusedSerialGrower(train_data, config, objective)
+        # persistent single-program iterations: pointwise objective, one
+        # tree per iteration, no bagging/GOSS/RF/DART score surgery
+        self._fused_persist = (
+            self._fused is not None and self._fused.persistent_capable
+            and self._fused._score_from_partition
+            and self.num_tree_per_iteration == 1
+            and config.boosting == "gbdt" and type(self) is GBDT)
         self._fused_check_every = 10
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
@@ -181,8 +190,29 @@ class GBDT:
         else:
             self._grad, self._hess = self.objective.get_gradients(score)
 
-    def get_training_score(self) -> jax.Array:
+    def device_score_state(self):
+        """The device array that per-iteration work actually updates —
+        for block_until_ready in benchmarks/profilers."""
+        if self._fused_state is not None:
+            return self._fused_state
         return self.train_score.score
+
+    def get_training_score(self) -> jax.Array:
+        if self._score_dirty and self._fused_state is not None:
+            # one scatter back to row order, only when a host consumer
+            # (metrics, refit, rollback, custom fobj) actually asks
+            self.train_score.score = \
+                self._fused.sync_scores(self._fused_state)[None, :]
+            self._score_dirty = False
+        return self.train_score.score
+
+    def _invalidate_fused_state(self) -> None:
+        """Call after any direct train_score mutation (rollback, refit,
+        DART normalize): the persistent planar state is rebuilt lazily
+        from the synced scores on the next iteration."""
+        if self._fused_state is not None:
+            self.get_training_score()
+            self._fused_state = None
 
     # ------------------------------------------------------------------
     def _bagging(self, iteration: int) -> None:
@@ -220,10 +250,12 @@ class GBDT:
         self._pred_revision = getattr(self, "_pred_revision", 0) + 1
         k = self.num_tree_per_iteration
         init_scores = [0.0] * k
-        if gradients is None or hessians is None:
+        custom_grad = gradients is not None and hessians is not None
+        if not custom_grad:
             for c in range(k):
                 init_scores[c] = self._boost_from_average(c, True)
-            self._boosting()
+            if not (self._fused_persist and self._fused is not None):
+                self._boosting()
         else:
             g = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, self.num_data))
             h = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, self.num_data))
@@ -232,6 +264,12 @@ class GBDT:
         self._bagging(self.iter)
 
         if self._fused is not None:
+            if self._fused_persist and not custom_grad:
+                return self._train_one_iter_persistent(init_scores)
+            if self._fused_persist and custom_grad:
+                # custom fobj supplies gradients in row order: leave the
+                # persistent state and fall through to the per-tree path
+                self._invalidate_fused_state()
             return self._train_one_iter_fused(init_scores)
 
         should_continue = False
@@ -267,6 +305,41 @@ class GBDT:
                 del self.models[-k:]
             return True
         self.iter += 1
+        return False
+
+    def _train_one_iter_persistent(self, init_scores) -> bool:
+        """Persistent fused path: the ENTIRE boosting iteration
+        (gradients, tree growth, score update) is one device program
+        over the leaf-permuted planar state — no [N]-sized scatter, no
+        repacking, zero synchronous host transfers."""
+        from ..treelearner.fused import PendingTree
+        if self._fused_state is None:
+            # created AFTER _boost_from_average, so the state's score
+            # already carries the init constant — in-program bias is 0
+            # (the PendingTree still gets add_bias for the model)
+            self._fused_state = self._fused.init_persistent_state(
+                self.get_training_score()[0])
+        self._fused_state, ta = self._fused.train_iter_persistent(
+            self._fused_state, self.shrinkage_rate, 0.0)
+        self._score_dirty = True
+        pending = PendingTree(self._fused, ta)
+        pending.apply_shrinkage(self.shrinkage_rate)
+        if self.valid_score:
+            vals = pending.leaf_values_device()
+            for vs in self.valid_score:
+                vleaf = self._fused._valid_traverse_jit(
+                    ta, vs.dataset.device_bins())
+                vs.score = vs.score.at[0].add(vals[vleaf])
+        if abs(init_scores[0]) > K_EPSILON:
+            pending.add_bias(init_scores[0])
+        self.models.append(pending)
+        self.iter += 1
+        if self.iter % self._fused_check_every == 0:
+            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-1:]):
+                self._trim_degenerate_tail()
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                return True
         return False
 
     def _train_one_iter_fused(self, init_scores) -> bool:
@@ -336,6 +409,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """reference GBDT::RollbackOneIter (gbdt.cpp:421)."""
         self._materialize_models()
+        self._invalidate_fused_state()
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration
@@ -390,7 +464,7 @@ class GBDT:
         if self.average_output and self.current_iteration > 0:
             div = float(self.current_iteration)
         if self.metrics:
-            sc = np.asarray(self.train_score.score) / div
+            sc = np.asarray(self.get_training_score()) / div
             for m in self.metrics:
                 for name, val in m.eval(sc[0] if sc.shape[0] == 1 else sc,
                                         self.objective):
@@ -666,6 +740,7 @@ class GBDT:
         self._pred_revision = getattr(self, "_pred_revision", 0) + 1
         leaf_pred = np.asarray(tree_leaf_prediction, dtype=np.int64)
         self._materialize_models()
+        self._invalidate_fused_state()
         self._boosting()
         grad = np.asarray(self._grad)
         hess = np.asarray(self._hess)

@@ -86,6 +86,20 @@ class ObjectiveFunction:
     def get_gradients(self, score):
         raise NotImplementedError
 
+    # -- persistent fused-loop hooks (treelearner/fused.py) ------------
+    # Pointwise objectives can run gradients INSIDE the single-dispatch
+    # training iteration, where rows live in leaf-permuted lane order.
+    # ``persistent_aux`` returns (label_plane, weight_plane_or_None):
+    # per-row constants that travel through the partition alongside the
+    # score; ``persistent_grads(score, label, weight)`` must be a pure
+    # jittable mirror of get_gradients over those planes. None = not
+    # supported (ranking and renew-output objectives).
+    def persistent_aux(self):
+        return None
+
+    def persistent_grads(self, score, label, weight):
+        raise NotImplementedError
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -123,6 +137,16 @@ class RegressionL2(ObjectiveFunction):
         g = score.astype(jnp.float32) - self._label_dev
         h = jnp.ones_like(g)
         return self._apply_weights(g, h)
+
+    def persistent_aux(self):
+        return self._label_dev, self._weights_dev
+
+    def persistent_grads(self, score, label, weight):
+        g = score - label
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
 
     def boost_from_score(self, class_id):
         if self.weights is not None:
@@ -180,6 +204,15 @@ class RegressionHuber(RegressionL2):
         h = jnp.ones_like(g)
         return self._apply_weights(g, h)
 
+    def persistent_grads(self, score, label, weight):
+        diff = score - label
+        g = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                      jnp.sign(diff) * self.alpha)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
 
 class RegressionFair(RegressionL2):
     name = "fair"
@@ -195,6 +228,15 @@ class RegressionFair(RegressionL2):
         g = c * x / (jnp.abs(x) + c)
         h = c * c / (jnp.abs(x) + c) ** 2
         return self._apply_weights(g, h)
+
+    def persistent_grads(self, score, label, weight):
+        x = score - label
+        c = self.c
+        g = c * x / (jnp.abs(x) + c)
+        h = c * c / (jnp.abs(x) + c) ** 2
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
 
     def boost_from_score(self, class_id):
         return 0.0
@@ -219,6 +261,13 @@ class RegressionPoisson(RegressionL2):
         g = jnp.exp(s) - self._label_dev
         h = jnp.exp(s + self.max_delta_step)
         return self._apply_weights(g, h)
+
+    def persistent_grads(self, score, label, weight):
+        g = jnp.exp(score) - label
+        h = jnp.exp(score + self.max_delta_step)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
 
     def boost_from_score(self, class_id):
         mean = RegressionL2.boost_from_score(self, class_id)
@@ -299,6 +348,13 @@ class RegressionGamma(RegressionPoisson):
         h = self._label_dev / jnp.exp(s)
         return self._apply_weights(g, h)
 
+    def persistent_grads(self, score, label, weight):
+        g = 1.0 - label / jnp.exp(score)
+        h = label / jnp.exp(score)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
 
 class RegressionTweedie(RegressionPoisson):
     name = "tweedie"
@@ -316,6 +372,15 @@ class RegressionTweedie(RegressionPoisson):
         h = (-y * (1 - rho) * jnp.exp((1 - rho) * s)
              + (2 - rho) * jnp.exp((2 - rho) * s))
         return self._apply_weights(g, h)
+
+    def persistent_grads(self, score, label, weight):
+        rho = self.rho
+        g = -label * jnp.exp((1 - rho) * score) + jnp.exp((2 - rho) * score)
+        h = (-label * (1 - rho) * jnp.exp((1 - rho) * score)
+             + (2 - rho) * jnp.exp((2 - rho) * score))
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
 
 
 # ---------------------------------------------------------------------------
@@ -363,6 +428,24 @@ class BinaryLogloss(ObjectiveFunction):
         g = response * self._lw
         h = abs_resp * (self.sigmoid - abs_resp) * self._lw
         return self._apply_weights(g, h)
+
+    def persistent_aux(self):
+        # one aux plane: signed per-row weight sign*lw*w (sign in {+-1},
+        # lw*w > 0) — recovered as sign() / abs() in persistent_grads
+        aux = self._sign * self._lw
+        if self._weights_dev is not None:
+            aux = aux * self._weights_dev
+        return aux, None
+
+    def persistent_grads(self, score, label, weight):
+        sign = jnp.sign(label)
+        lw = jnp.abs(label)
+        response = -sign * self.sigmoid / \
+            (1.0 + jnp.exp(sign * self.sigmoid * score))
+        abs_resp = jnp.abs(response)
+        g = response * lw
+        h = abs_resp * (self.sigmoid - abs_resp) * lw
+        return g, h
 
     def boost_from_score(self, class_id):
         if self.weights is not None:
@@ -474,6 +557,17 @@ class CrossEntropy(ObjectiveFunction):
         g = z - self._label_dev
         h = z * (1.0 - z)
         return self._apply_weights(g, h)
+
+    def persistent_aux(self):
+        return self._label_dev, self._weights_dev
+
+    def persistent_grads(self, score, label, weight):
+        z = 1.0 / (1.0 + jnp.exp(-score))
+        g = z - label
+        h = z * (1.0 - z)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
 
     def boost_from_score(self, class_id):
         if self.weights is not None:

@@ -339,6 +339,147 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     return hd
 
 
+# ---------------------------------------------------------------------------
+# Planar-native radix histogram: same MXU formulation, but reading the
+# [P, R] planar training state of ops/plane.py DIRECTLY — the per-
+# feature code rows are unpacked from the int32 code planes in-kernel
+# (static byte shifts), grad/hess are bitcast from their planes, and the
+# leaf window is masked by prefetched [off, count) scalars. This removes
+# the planar→row-major bridge (a transpose + two extra HBM passes per
+# histogram) that profiling showed as the dominant copy cost after the
+# partition kernel landed.
+# ---------------------------------------------------------------------------
+
+
+def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
+                         bl_bits, dtype, code_bytes, grad_plane, Rb):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    i = pl.program_id(0)
+    x = data_ref[...]                              # [P, Rb] i32
+    off, count = scal[1], scal[2]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
+    valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
+
+    gh = jax.lax.bitcast_convert_type(
+        x[grad_plane:grad_plane + 2, :], jnp.float32)
+    g_t = (gh[0:1, :] * valid).astype(dtype)
+    h_t = (gh[1:2, :] * valid).astype(dtype)
+
+    # unpack feature code rows from the packed planes: k codes per
+    # plane, feature f = plane*k + j at byte j*code_bytes (ops/plane.py
+    # little-endian packing)
+    k = 4 // code_bytes
+    mask = (1 << (8 * code_bytes)) - 1
+    Fp = C * Fc
+    npl = Fp // k
+    planes = x[0:npl, :]
+    e = jnp.broadcast_to(planes[:, None, :], (npl, k, Rb)) \
+        .reshape(npl * k, Rb)
+    sh = (jax.lax.broadcasted_iota(jnp.int32, (Fp, 1), 0) % k) \
+        * (8 * code_bytes)
+    ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fp, Rb]
+
+    lo_t = (ct & (Bl - 1)).astype(dtype)
+    hi_t = (ct >> bl_bits).astype(dtype)
+
+    fcl, fch = Fc * Bl, Fc * Bh
+    ex_lo = (jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 0) // Bl ==
+             jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 1)).astype(dtype)
+    slot_lo = (jax.lax.broadcasted_iota(
+        jnp.int32, (fcl, 1), 0) % Bl).astype(jnp.float32)
+    ex_hi = (jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 0) // Bh ==
+             jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 1)).astype(dtype)
+    slot_hi = (jax.lax.broadcasted_iota(
+        jnp.int32, (fch, 1), 0) % Bh).astype(jnp.float32)
+
+    for c in range(C):
+        lo_c = lo_t[c * Fc:(c + 1) * Fc, :]
+        hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
+        mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
+                 == slot_lo).astype(dtype)
+        mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
+                 == slot_hi)
+        ag = mhi_t.astype(dtype) * g_t
+        ah = mhi_t.astype(dtype) * h_t
+        pg = jax.lax.dot_general(
+            ag, mlo_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        ph = jax.lax.dot_general(
+            ah, mlo_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        out_ref[c, 0:fch, :] += pg
+        out_ref[c, fch:2 * fch, :] += ph
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
+                                             "code_bytes", "grad_plane",
+                                             "cap", "dtype",
+                                             "rows_per_block", "interpret"))
+def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
+                            num_cols: int, code_bytes: int, grad_plane: int,
+                            cap: int, dtype=jnp.float32,
+                            rows_per_block: int = 512,
+                            interpret: bool = False) -> jax.Array:
+    """Leaf-window histogram straight off the planar state.
+
+    data: [P, R] int32 planar training rows; the window is the lane
+    range [start, start+count), read as `cap//Rb + 1` aligned blocks.
+    Returns [num_cols, num_bins, 2] f32.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, R = data.shape
+    Rb = rows_per_block
+    bh_bits, bl_bits = _radix_dims(num_bins)
+    Bh, Bl = 1 << bh_bits, 1 << bl_bits
+    Fc = max(1, 128 // Bl)
+    # chunks must cover whole planes: Fc*code_bytes multiple of 4
+    while (Fc * code_bytes) % 4:
+        Fc *= 2
+    C = -(-num_cols // Fc)
+    nblk = cap // Rb + 1
+    assert nblk * Rb <= R
+
+    start = jnp.asarray(start, jnp.int32)
+    rs_blk = jnp.clip(start // Rb, 0, R // Rb - nblk)
+    off = start - rs_blk * Rb
+    scal = jnp.stack([rs_blk, off, jnp.asarray(count, jnp.int32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((P, Rb), lambda i, scal: (0, scal[0] + i))],
+        out_specs=pl.BlockSpec((C, 2 * Fc * Bh, Fc * Bl),
+                               lambda i, scal: (0, 0, 0)),
+        scratch_shapes=[],
+    )
+    out = pl.pallas_call(
+        functools.partial(_radix_planar_kernel, C=C, Fc=Fc, Bh=Bh, Bl=Bl,
+                          bl_bits=bl_bits, dtype=dtype,
+                          code_bytes=code_bytes, grad_plane=grad_plane,
+                          Rb=Rb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((C, 2 * Fc * Bh, Fc * Bl),
+                                       jnp.float32),
+        interpret=interpret,
+    )(scal, data)
+
+    h_all = out.reshape(C, 2, Fc, Bh, Fc, Bl)
+    idx = jnp.arange(Fc)
+    hd = h_all[:, :, idx, :, idx, :]
+    hd = jnp.transpose(hd, (1, 0, 3, 4, 2))
+    hd = hd.reshape(C * Fc, Bh * Bl, 2)[:num_cols, :num_bins, :]
+    return hd
+
+
 def _use_tpu() -> bool:
     return jax.default_backend() == "tpu"
 

@@ -11,18 +11,22 @@ tree MUST run inside one compiled program:
   sums, outputs, best-split records, the histogram pool) lives in
   fixed-size [num_leaves] device arrays — the HistogramPool
   (feature_histogram.hpp:1061) becomes a dense [L, F, B, 2] pool.
-- DataPartition::Split becomes a full-length masked-cumsum stable
-  partition (no sort): new positions are prefix sums of the left/right
-  predicates inside the leaf's window, identity outside — O(N) per
-  split, one scatter.
-- Leaf histograms use `lax.switch` over power-of-two capacity buckets,
-  giving the smaller-child gather dynamic cost under static shapes;
-  the larger child is histogram subtraction, as in the reference
-  (:396-404).
-- Gradients, the tree build, shrinkage and the score update all fuse
-  into the same program, so an iteration with no evaluation requires
-  ZERO synchronous host transfers — trees come back as device arrays
-  materialized lazily.
+- Training rows live in the PLANAR [P, R] int32 layout of ops/plane.py
+  (bin-code byte planes + grad/hess/label/score/row-id planes,
+  lane-major). DataPartition::Split (data_partition.hpp:72) is the
+  Pallas carry-stream kernel: in-register block compaction + aligned
+  DMA writes — no per-row gather/scatter/sort anywhere in the loop,
+  which removed the ~37-140 ns/row access tolls that dominated every
+  row-major formulation (docs/PERF_NOTES.md).
+- Leaf histograms use `lax.switch` over capacity buckets; the smaller
+  child is histogrammed at its own bucket, the larger child is
+  histogram subtraction, as in the reference (:396-404).
+- In the persistent mode (no bagging, pointwise objective, one tree
+  per iteration) the score/label/row-id ride inside the planar state
+  ACROSS iterations in leaf-permuted order: gradients, tree growth,
+  and the score update all happen in one program with zero [N]-sized
+  scatters; scores are scattered back to row order only when a host
+  consumer asks (GBDT.get_training_score).
 
 Coverage: numerical features, serial learner, any objective without
 leaf renewal, bagging via a host-provided permutation, per-tree
@@ -46,6 +50,7 @@ from ..io.dataset import BinnedDataset
 from ..io.binning import BIN_CATEGORICAL
 from ..models.tree import Tree
 from ..ops import histogram as H
+from ..ops import plane
 from ..ops import split as S
 from ..utils import log
 
@@ -82,7 +87,7 @@ def fused_supported(config: Config, dataset: BinnedDataset,
 
 class FusedTreeState(NamedTuple):
     """Loop-carried device state; [L] = num_leaves slots."""
-    data: jax.Array            # [N, W] leaf-ordered packed rows (u8)
+    data: jax.Array            # [P, R] planar training rows
     n_leaves: jax.Array        # scalar i32
     leaf_start: jax.Array      # [L]
     leaf_count: jax.Array      # [L]
@@ -122,9 +127,11 @@ class FusedTreeState(NamedTuple):
 class FusedSerialGrower:
     """Builds and owns the single-dispatch training-iteration program."""
 
-    def __init__(self, dataset: BinnedDataset, config: Config) -> None:
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 objective=None) -> None:
         self.dataset = dataset
         self.config = config
+        self.objective = objective
         self.bins = dataset.device_bins()
         self.num_features = dataset.num_features
         mappers = dataset.bin_mappers
@@ -166,18 +173,27 @@ class FusedSerialGrower:
             self._hist_method = ("radix_pallas"
                                  if config.tpu_hist_dtype == "float32"
                                  else "radix_pallas_bf16")
+            self._part_method = "pallas"
         else:
             self._hist_method = None
-        # leaf-ordered packed row layout: [G*cb bin-code bytes | 8 bytes
-        # f32 (grad, hess) | 4 bytes i32 original row id]. TPU random
-        # row gathers/scatters run at ~10ns/row regardless of width, so
-        # the whole training row travels as ONE descriptor during the
-        # partition scatter and every histogram READ is a contiguous
-        # dynamic_slice at HBM speed (see _split_step).
+            self._part_method = "ref"
+
+        # planar layout: label/score/weight planes only when the
+        # objective can run the persistent in-program loop
         self._num_cols = int(self.bins.shape[1])
         self._code_bytes = int(np.dtype(self.bins.dtype).itemsize)
-        self._row_width = self._num_cols * self._code_bytes + 12
-        self._code_bytes_dev = None  # built lazily on first grow
+        n = dataset.num_data
+        persist = (objective is not None
+                   and getattr(objective, "persistent_aux", None) is not None
+                   and objective.persistent_aux() is not None
+                   and objective.num_tree_per_iteration == 1)
+        has_w = persist and objective.persistent_aux()[1] is not None
+        self.layout = plane.make_layout(
+            self._num_cols, self._code_bytes, n,
+            with_label=persist, with_score=persist, with_weight=has_w)
+        self.persistent_capable = persist
+        self._codes_planes_dev = None   # built lazily
+
         # histogram_pool_size (MB; <=0 unlimited — reference
         # feature_histogram.hpp:1061 HistogramPool): when the dense
         # [L, F, B, 2] pool would not fit, run pool-less — both
@@ -205,26 +221,30 @@ class FusedSerialGrower:
         self._score_from_partition = not bag_active
 
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
-        n = dataset.num_data
-        # capacity ladder for the lax.switch histogram/partition
-        # branches. Each branch duplicates the full kernel in the
-        # compiled program, so XLA compile time grows with the ladder
-        # size — factor 4 keeps it at ~log4(N) branches (5 at 1M rows
-        # vs 13 for factor 2) for at most 4x padded work on mid-size
-        # leaves (the dominant root/early splits sit in the top bucket
-        # either way, and the smaller-child trick bounds the rest).
+        # capacity ladder for the lax.switch partition/histogram
+        # branches, in lane-tile units. Factor 4 keeps the program small
+        # (each branch duplicates its kernels); the carry-stream kernel
+        # cost scales with the window so padding costs bandwidth only.
+        tile = self.layout.tile
+        top = self.layout.num_lanes - tile
         self._caps = []
-        c = 4096
-        while c < n:
+        c = tile * 4
+        while c < top:
             self._caps.append(c)
             c *= 4
-        # top bucket is exactly n: the next power of four would pad the
-        # root splits by up to 1.6x (measured 10.5M -> 16.7M at HIGGS)
-        self._caps.append(n)
+        self._caps.append(top)
         self._grow_jit = jax.jit(self._grow_tree,
                                  static_argnames=("compute_score_update",))
+        self._iter_jit = jax.jit(self._train_iter, donate_argnums=0)
+        self._sync_jit = jax.jit(self._sync_scores)
 
     # ------------------------------------------------------------------
+    def codes_planes(self) -> jax.Array:
+        if self._codes_planes_dev is None:
+            self._codes_planes_dev = plane.build_codes_planes(
+                self.bins, self.layout)
+        return self._codes_planes_dev
+
     def _switch_by_cap(self, count, branches_of_cap, *args):
         branches = [branches_of_cap(c) for c in self._caps]
         cap_arr = jnp.asarray(self._caps, jnp.int32)
@@ -233,86 +253,56 @@ class FusedSerialGrower:
         return jax.lax.switch(idx, branches, *args)
 
     def _window_hist(self, b, g, h):
-        """Histogram of an already-loaded bin block with masked weights;
-        EFB bundle columns are gathered back to per-feature space
-        (FixHistogram mfb reconstruction)."""
+        """Histogram of bin codes with masked weights; EFB bundle
+        columns are gathered back to per-feature space (FixHistogram
+        mfb reconstruction)."""
+        nbins = (self.group_max_bin if self._efb_hist is not None
+                 else self.max_num_bin)
+        return self._hist_from_groups(
+            H.histogram(b, g, h, nbins, method=self._hist_method))
+
+    def _hist_from_groups(self, ghist):
+        """Group-level [G, Bg, 2] -> per-feature [F, B, 2] (EFB
+        FixHistogram mfb reconstruction) or identity when unbundled."""
         if self._efb_hist is None:
-            return H.histogram(b, g, h, self.max_num_bin,
-                               method=self._hist_method)
+            return ghist
         from ..io.efb import per_feature_hist
-        ghist = H.histogram(b, g, h, self.group_max_bin,
-                            method=self._hist_method)
         total = ghist[0].sum(axis=0)
         return per_feature_hist(ghist, self._efb_hist, total[0], total[1])
 
-    # -- leaf-ordered packed rows --------------------------------------
-    def code_bytes_dev(self):
-        """[N, G*cb] uint8 bin-code bytes, built once. Passed to the
-        jitted tree builder as an ARGUMENT — a closure capture would
-        embed the full matrix as an HLO constant (294 MB at HIGGS
-        scale, which overflows remote-compile request limits)."""
-        if self._code_bytes_dev is None:
-            b = self.bins
-            if self._code_bytes > 1:
-                b = jax.lax.bitcast_convert_type(b, jnp.uint8).reshape(
-                    b.shape[0], self._num_cols * self._code_bytes)
-            self._code_bytes_dev = b
-        return self._code_bytes_dev
-
-    def _pack_rows(self, codes_bytes, perm0, gh2):
-        """[N, W] uint8 leaf-ordered training rows (bin-code bytes +
-        f32 grad/hess bytes + i32 row-id bytes). Without bagging the
-        initial leaf order IS row order, so the pack is a contiguous
-        concat (no gather); with bagging it costs one row gather per
-        tree instead of one per split."""
-        n = perm0.shape[0]
-        gh_b = jax.lax.bitcast_convert_type(
-            gh2.astype(jnp.float32), jnp.uint8).reshape(n, 8)
-        row_b = jax.lax.bitcast_convert_type(
-            perm0.astype(jnp.int32), jnp.uint8)
-        if self._score_from_partition:  # perm0 == arange
-            return jnp.concatenate([codes_bytes, gh_b, row_b], axis=1)
-        return jnp.concatenate(
-            [codes_bytes[perm0], gh_b[perm0], row_b], axis=1)
-
-    def _unpack_block(self, block):
-        """[cap, W] u8 -> (codes [cap, G] int, gh [cap, 2] f32)."""
-        cap = block.shape[0]
-        G, cb = self._num_cols, self._code_bytes
-        if cb == 1:
-            codes = block[:, :G]
-        else:
-            codes = jax.lax.bitcast_convert_type(
-                block[:, :G * cb].reshape(cap, G, cb), jnp.uint16)
-        gh = jax.lax.bitcast_convert_type(
-            block[:, G * cb:G * cb + 8].reshape(cap, 2, 4), jnp.float32)
-        return codes, gh
-
-    def _row_ids(self, data):
-        return jax.lax.bitcast_convert_type(data[:, -4:], jnp.int32)
-
-    def _read_window(self, data, start, count, cap):
-        """Contiguous [cap, W] window covering [start, start+count);
-        returns (block, valid, read_start). The capacity ladder tops out
-        at exactly N, so cap <= N always."""
-        n = data.shape[0]
-        assert cap <= n, "capacity ladder must top out at num_data"
-        start = jnp.asarray(start, jnp.int32)
-        read_start = jnp.minimum(start, n - cap)
-        block = jax.lax.dynamic_slice(
-            data, (read_start, 0), (cap, data.shape[1]))
-        off = start - read_start
-        pos = jnp.arange(cap, dtype=jnp.int32)
-        valid = (pos >= off) & (pos < off + count)
-        return block, valid, read_start
-
     def _leaf_hist_switch(self, data, start, count):
-        """Histogram of a leaf range: a contiguous slice of the
-        leaf-ordered rows + masked radix matmul — no gather at all."""
+        """Histogram of a leaf range straight off the planar state; the
+        CPU/oracle path goes through the row-major bridge instead."""
+        Ly = self.layout
+        R = Ly.num_lanes
+        nbins = (self.group_max_bin if self._efb_hist is not None
+                 else self.max_num_bin)
+        # planar kernel unpacks C*Fc padded feature rows from the planes;
+        # ensure the padding never reads past the plane count
+        bh_bits, bl_bits = H._radix_dims(nbins)
+        fc = max(1, 128 // (1 << bl_bits))
+        while (fc * Ly.code_bytes) % 4:
+            fc *= 2
+        npl = (-(-Ly.num_cols // fc)) * fc * Ly.code_bytes // 4
+        planar_ok = (self._hist_method is not None
+                     and npl <= Ly.num_planes)
+        dtype = (jnp.bfloat16 if self._hist_method == "radix_pallas_bf16"
+                 else jnp.float32)
+
         def branch(cap):
             def fn(data, start, count):
-                block, valid, _ = self._read_window(data, start, count, cap)
-                codes, gh = self._unpack_block(block)
+                if planar_ok:
+                    ghist = H.histogram_planar_pallas(
+                        data, start, count, num_bins=nbins,
+                        num_cols=Ly.num_cols, code_bytes=Ly.code_bytes,
+                        grad_plane=Ly.grad, cap=cap, dtype=dtype)
+                    return self._hist_from_groups(ghist)
+                rs = jnp.clip(jnp.asarray(start, jnp.int32), 0, R - cap)
+                codes, gh = plane.window_rowmajor(data, self.layout, rs,
+                                                  cap=cap)
+                off = jnp.asarray(start, jnp.int32) - rs
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                valid = (pos >= off) & (pos < off + count)
                 g = jnp.where(valid, gh[:, 0], 0.0)
                 h = jnp.where(valid, gh[:, 1], 0.0)
                 return self._window_hist(codes, g, h)
@@ -321,70 +311,21 @@ class FusedSerialGrower:
         return self._switch_by_cap(count, branch, data, start, count)
 
     def _split_step(self, data, start, count, feature, thr, dl, miss_bin):
-        """Split one leaf: ONE contiguous read of its row block, the
-        routing decision, a single row-scatter writing the partitioned
-        block back, and the smaller child's histogram from the same
-        block. This is the TPU answer to DataPartition::Split +
-        ConstructHistograms: random access is concentrated in one
-        in-window row scatter (~10ns/row); everything else is
-        slice-contiguous. Returns (data, nleft, hist_smaller)."""
-        efb = self._efb_dev
+        """Split one leaf: the carry-stream partition kernel moves its
+        rows (ops/plane.py), then the smaller child's histogram comes
+        from the freshly contiguous range at its own capacity bucket."""
+        rscal = plane.route_scalars(self.layout, feature, thr, dl, miss_bin,
+                                    self._efb_dev)
 
         def branch(cap):
-            def fn(data, start, count, feature, thr, dl, miss_bin):
-                n = data.shape[0]
-                block, valid, read_start = self._read_window(
-                    data, start, count, cap)
-                codes, gh = self._unpack_block(block)
-
-                # --- routing on the split column. The column pick is a
-                # one-hot matmul, NOT take_along_axis: a traced column
-                # index lowers to a per-row gather (~7ns/row — measured
-                # as the single hottest op of the old split step) while
-                # the [cap, G] @ [G] product rides the MXU for free ---
-                gidx = efb[0][feature] if efb is not None else feature
-                sel = (jnp.arange(codes.shape[1]) == gidx).astype(jnp.float32)
-                col = jnp.einsum(
-                    "rg,g->r", codes.astype(jnp.float32), sel,
-                    precision="highest").astype(jnp.int32)
-                if efb is not None:
-                    from ..io.efb import decode_bins
-                    binval = decode_bins(col, feature, efb)
-                else:
-                    binval = col
-                from ..ops.partition import _decision_go_left
-                go_left = _decision_go_left(binval, thr, dl, miss_bin,
-                                            jnp.bool_(False))
-
-                # --- stable partition: argsort of the 4-way key gives
-                # the inverse permutation directly (pre-window rows
-                # first in original order, then lefts, rights, tail) —
-                # no scatter at all; TPU scatters (even 4-byte ones)
-                # degrade badly beyond ~2M-row tables, sorts don't ---
-                pos = jnp.arange(cap, dtype=jnp.int32)
-                off = jnp.asarray(start, jnp.int32) - read_start
-                gl = go_left & valid
-                gr = (~go_left) & valid
-                nleft = jnp.sum(gl).astype(jnp.int32)
-                key = jnp.where(pos < off, jnp.int8(0),
-                                jnp.where(gl, jnp.int8(1),
-                                          jnp.where(gr, jnp.int8(2),
-                                                    jnp.int8(3))))
-                inv = jnp.argsort(key, stable=True)
-                # row gathers run ~11 ns/row for <=1M-row blocks and
-                # ~37 ns/row beyond (source-table size bound; chunking
-                # the index stream was measured neutral)
-                new_block = block[inv]
-                data = jax.lax.dynamic_update_slice(
-                    data, new_block, (read_start, 0))
-                return data, nleft
+            def fn(data, start, count, rscal):
+                return plane.partition_window(
+                    data, self.layout, start, count, rscal, cap=cap,
+                    method=self._part_method)
             return fn
 
         data, nleft = self._switch_by_cap(count, branch, data, start, count,
-                                          feature, thr, dl, miss_bin)
-        # smaller child's histogram at ITS OWN capacity bucket — the
-        # post-partition child range is a contiguous slice, and the
-        # pallas matmul volume halves vs histogramming the parent block
+                                          rscal)
         left_smaller = nleft <= count - nleft
         s_start = jnp.where(left_smaller, start, start + nleft)
         s_count = jnp.where(left_smaller, nleft, count - nleft)
@@ -424,19 +365,14 @@ class FusedSerialGrower:
         return first, second
 
     # ------------------------------------------------------------------
-    def _grow_tree(self, codes_bytes, grad, hess, perm0, bag_cnt,
-                   feature_mask,
-                   compute_score_update: bool = True):
-        """The single-dispatch tree builder. Returns (tree arrays dict,
-        leaf_value_update [N] or None)."""
+    def _grow_tree_core(self, data, bag_cnt, feature_mask):
+        """The while_loop tree builder over planar data. Returns
+        (tree arrays dict, final FusedTreeState)."""
         L = self.num_leaves
         F, B = self.num_features, self.max_num_bin
-        n = perm0.shape[0]
         f32, i32 = jnp.float32, jnp.int32
-        gh2 = jnp.stack([grad, hess], axis=1)
-        data0 = self._pack_rows(codes_bytes, perm0, gh2)
 
-        root_hist = self._leaf_hist_switch(data0, jnp.int32(0), bag_cnt)
+        root_hist = self._leaf_hist_switch(data, jnp.int32(0), bag_cnt)
         sum_g = jnp.sum(root_hist[0, :, 0])
         sum_h = jnp.sum(root_hist[0, :, 1])
         root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt,
@@ -447,7 +383,7 @@ class FusedSerialGrower:
             return jnp.full((L,), val, dtype)
 
         st = FusedTreeState(
-            data=data0, n_leaves=i32(1),
+            data=data, n_leaves=i32(1),
             leaf_start=arr(0, i32).at[0].set(0),
             leaf_count=arr(0, i32).at[0].set(bag_cnt),
             leaf_sum_g=arr(0.0).at[0].set(sum_g),
@@ -524,7 +460,7 @@ class FusedSerialGrower:
             t_iweight = st.t_iweight.at[node].set(st.leaf_sum_h[leaf])
             t_icount = st.t_icount.at[node].set(st.leaf_count[leaf])
 
-            # --- partition + smaller-child histogram (one block) ---
+            # --- partition + smaller-child histogram ---
             start = st.leaf_start[leaf]
             count = st.leaf_count[leaf]
             new_data, nleft, hist_small = self._split_step(
@@ -626,48 +562,149 @@ class FusedSerialGrower:
             leaf_value=st.leaf_output, leaf_weight=st.leaf_sum_h,
             leaf_count=st.leaf_count, leaf_depth=st.leaf_depth,
         )
+        return tree_arrays, st
+
+    # ------------------------------------------------------------------
+    def _pos_leaf_terms(self, st: FusedTreeState):
+        """Sorted leaf-window starts + sort order (tiny [L] work)."""
+        L = self.num_leaves
+        lid = jnp.arange(L, dtype=jnp.int32)
+        valid = lid < st.n_leaves
+        starts = jnp.where(valid, st.leaf_start,
+                           jnp.int32(self.layout.num_lanes) + 1)
+        order = jnp.argsort(starts)
+        return starts[order], order
+
+    def _pos_leaf(self, st: FusedTreeState):
+        """Leaf id per LANE via broadcast compare (no [N] gather): the
+        rank of each position among the sorted starts, then the tiny
+        order table applied as an equality-weighted reduction."""
+        sorted_starts, order = self._pos_leaf_terms(st)
+        pos = jnp.arange(self.layout.num_lanes, dtype=jnp.int32)
+        k = jnp.sum(pos[:, None] >= sorted_starts[None, :],
+                    axis=1).astype(jnp.int32) - 1
+        k = jnp.maximum(k, 0)
+        # order[k] without a per-row gather: sum_j order_j * [k == j]
+        L = self.num_leaves
+        lid = jnp.arange(L, dtype=jnp.int32)
+        return jnp.sum(jnp.where(k[:, None] == lid[None, :],
+                                 order[None, :], 0), axis=1).astype(jnp.int32)
+
+    def _score_add_by_pos(self, st: FusedTreeState, leaf_vals):
+        """Per-lane leaf value as a sum of step functions over the
+        sorted window starts — fuses on the VPU, no [N] gather and no
+        materialized one-hot."""
+        sorted_starts, order = self._pos_leaf_terms(st)
+        vals_sorted = leaf_vals[order]          # [L] gather — tiny
+        d = vals_sorted - jnp.concatenate(
+            [jnp.zeros((1,), jnp.float32), vals_sorted[:-1]])
+        pos = jnp.arange(self.layout.num_lanes, dtype=jnp.int32)
+        steps = (pos[:, None] >= sorted_starts[None, :]).astype(jnp.float32)
+        return jnp.sum(steps * d[None, :], axis=1)
+
+    # ------------------------------------------------------------------
+    def _grow_tree(self, codes_planes, grad, hess, perm, bag_cnt,
+                   feature_mask, bins_rowmajor=None,
+                   compute_score_update: bool = True):
+        """Per-tree program for the non-persistent path. Returns
+        (tree arrays dict, leaf_of_row [n] in ORIGINAL row order or
+        None). ``bins_rowmajor`` is passed as a jit ARGUMENT on the
+        bagging path — a self.bins closure would embed the full bin
+        matrix as an HLO constant (hundreds of MB at HIGGS scale, which
+        overflows remote-compile request limits)."""
+        n = self.layout.num_rows
+        data = plane.build_data(self.layout, codes_planes, grad, hess,
+                                rowid=perm)
+        ta, st = self._grow_tree_core(data, bag_cnt, feature_mask)
 
         leaf_of_row = None
         if compute_score_update:
             if self._score_from_partition:
-                # the partition already assigned every row to a leaf:
-                # leaf intervals [start, start+count) tile [0, N), so a
-                # searchsorted over the sorted starts + a scatter through
-                # the row ids yields leaf-of-row without re-walking
-                # the tree (the DataPartition shortcut of the reference's
-                # ScoreUpdater::AddScore, score_updater.hpp:88 — here it
-                # replaces an ~O(depth) gather chain per iteration)
-                leaf_of_row = self._leaf_ids_from_partition(st, n)
+                pos_leaf = self._pos_leaf(st)
+                rowids = st.data[self.layout.rowid][:n]
+                leaf_of_row = jnp.zeros(n, jnp.int32).at[rowids].set(
+                    pos_leaf[:n], unique_indices=True)
             else:
-                # bagging: re-walk the tree over the ROW-ORDERED bins,
-                # reconstructed from the code bytes arg (a self.bins
-                # closure would embed the matrix as an HLO constant)
-                bins_mat = codes_bytes
-                if self._code_bytes > 1:
-                    bins_mat = jax.lax.bitcast_convert_type(
-                        codes_bytes.reshape(n, self._num_cols,
-                                            self._code_bytes), jnp.uint16)
-                leaf_of_row = self.traverse_bins(tree_arrays, bins_mat)
-        return tree_arrays, leaf_of_row
+                leaf_of_row = self.traverse_bins(ta, bins_rowmajor)
+        return ta, leaf_of_row
 
-    def _leaf_ids_from_partition(self, st: FusedTreeState, n: int):
-        L = self.num_leaves
-        lid = jnp.arange(L, dtype=jnp.int32)
-        valid = lid < st.n_leaves
-        starts = jnp.where(valid, st.leaf_start, jnp.int32(n) + 1)
-        order = jnp.argsort(starts)             # tiny: [num_leaves]
-        sorted_starts = starts[order]
-        pos = jnp.arange(n, dtype=jnp.int32)
-        # rank of each position among the sorted starts as a broadcast
-        # compare-and-sum ([N, L] fused on the VPU) — jnp.searchsorted
-        # binary-search gathers cost ~8 passes of per-element access
-        k = jnp.sum(pos[:, None] >= sorted_starts[None, :],
-                    axis=1).astype(jnp.int32) - 1
-        pos_leaf = order[jnp.maximum(k, 0)]
-        row_ids = self._row_ids(st.data)
-        return jnp.zeros(n, jnp.int32).at[row_ids].set(pos_leaf,
-                                                       unique_indices=True)
+    def grow_device(self, grad, hess, perm, bag_cnt,
+                    compute_score_update=True):
+        """Returns (tree_arrays dict of device arrays, leaf_of_row)."""
+        if self._score_from_partition:
+            cp = self.codes_planes()
+            perm_dev = jnp.arange(self.layout.num_rows, dtype=jnp.int32)
+            g, h = grad, hess
+            bins_arg = None
+        else:
+            # bagging: one row gather per TREE (not per split) to build
+            # the bag-ordered planar pack
+            perm_dev = jnp.asarray(perm, jnp.int32)
+            cp = plane.build_codes_planes(self.bins[perm_dev], self.layout)
+            g, h = grad[perm_dev], hess[perm_dev]
+            bins_arg = self.bins
+        return self._grow_jit(cp, g, h, perm_dev, jnp.int32(bag_cnt),
+                              self.feature_mask_tree(), bins_arg,
+                              compute_score_update=compute_score_update)
 
+    # -- persistent mode -----------------------------------------------
+    def init_persistent_state(self, score_vec) -> jax.Array:
+        """Planar state carrying label/score/row-id across iterations.
+        score_vec: [n] f32 current raw scores in ORIGINAL row order."""
+        assert self.persistent_capable
+        aux_label, aux_weight = self.objective.persistent_aux()
+        return plane.build_data(
+            self.layout, self.codes_planes(),
+            jnp.zeros(self.layout.num_rows, jnp.float32),
+            jnp.zeros(self.layout.num_rows, jnp.float32),
+            label=jnp.asarray(aux_label, jnp.float32),
+            score=jnp.asarray(score_vec, jnp.float32),
+            weight=(None if aux_weight is None
+                    else jnp.asarray(aux_weight, jnp.float32)))
+
+    def _train_iter(self, data, feature_mask, shrinkage, bias):
+        """One full boosting iteration in ONE program: gradients from
+        the in-state score, tree growth, and the score update — all in
+        leaf-permuted lane order (GBDT::TrainOneIter, gbdt.cpp:337,
+        minus the host loop)."""
+        Ly = self.layout
+        n = Ly.num_rows
+        lanes = jnp.arange(Ly.num_lanes, dtype=jnp.int32)
+        realm = lanes < jnp.int32(n)  # pad lanes never enter any window
+
+        score = plane.get_f32(data, Ly.score)
+        label = plane.get_f32(data, Ly.label)
+        weight = plane.get_f32(data, Ly.weight) if Ly.weight >= 0 else None
+        g, h = self.objective.persistent_grads(score, label, weight)
+        g = jnp.where(realm, g, 0.0)
+        h = jnp.where(realm, h, 0.0)
+        data = plane.set_gh(data, Ly, g, h)
+
+        ta, st = self._grow_tree_core(data, jnp.int32(n), feature_mask)
+
+        vals = ta["leaf_value"] * shrinkage
+        add = self._score_add_by_pos(st, vals.astype(jnp.float32))
+        score2 = plane.get_f32(st.data, Ly.score) + add + bias
+        data = plane.set_f32(st.data, Ly.score, score2)
+        return data, ta
+
+    def train_iter_persistent(self, data, shrinkage, bias):
+        return self._iter_jit(data, self.feature_mask_tree(),
+                              jnp.float32(shrinkage), jnp.float32(bias))
+
+    def _sync_scores(self, data):
+        n = self.layout.num_rows
+        rowids = data[self.layout.rowid][:n]
+        score = plane.get_f32(data, self.layout.score)[:n]
+        return jnp.zeros(n, jnp.float32).at[rowids].set(
+            score, unique_indices=True)
+
+    def sync_scores(self, data) -> jax.Array:
+        """[n] f32 raw scores in original row order (one scatter — only
+        runs when a host consumer asks)."""
+        return self._sync_jit(data)
+
+    # ------------------------------------------------------------------
     def _traverse_device(self, ta) -> jax.Array:
         return self.traverse_bins(ta, self.bins)
 
@@ -722,13 +759,6 @@ class FusedSerialGrower:
             mask[:] = False
             mask[chosen] = True
         return jnp.asarray(mask)
-
-    def grow_device(self, grad, hess, perm, bag_cnt,
-                    compute_score_update=True):
-        """Returns (tree_arrays dict of device arrays, leaf_of_row)."""
-        return self._grow_jit(self.code_bytes_dev(), grad, hess, perm,
-                              jnp.int32(bag_cnt), self.feature_mask_tree(),
-                              compute_score_update=compute_score_update)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _valid_traverse_jit(self, ta, bins):

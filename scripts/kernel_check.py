@@ -1,0 +1,92 @@
+"""Partition kernel correctness + throughput check on the real device.
+
+Compares partition_pallas against partition_ref on random states and
+times the kernel at HIGGS-ish window sizes. Run on TPU hardware.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import plane
+
+
+def check(n, g, start, count, feat, thr, seed, tile=2048):
+    rng = np.random.RandomState(seed)
+    codes = rng.randint(0, 250, size=(n, g)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    layout = plane.make_layout(g, 1, n, with_label=True, with_score=True,
+                               tile=tile)
+    cp = plane.build_codes_planes(jnp.asarray(codes), layout)
+    data = plane.build_data(layout, cp, jnp.asarray(grad), jnp.asarray(hess),
+                            label=jnp.asarray(grad), score=jnp.asarray(hess))
+    rscal = plane.route_scalars(layout, feat, thr, 1, 249)
+    cap = tile
+    while cap < count and cap * 4 <= layout.num_lanes - tile:
+        cap *= 4
+    cap = min(max(cap, count), layout.num_lanes - tile)
+    # round cap up to tile multiple
+    cap = -(-cap // tile) * tile
+    ref, nl_ref = plane.partition_ref(data, layout, start, count, rscal,
+                                      cap=cap)
+    got, nl_got = plane.partition_pallas(data, layout, start, count, rscal,
+                                         cap=cap)
+    jax.block_until_ready((ref, got))
+    ok_n = int(nl_ref) == int(nl_got)
+    ok_d = bool(jnp.all(ref == got))
+    print(f"n={n} start={start} count={count} cap={cap}: "
+          f"nleft ref={int(nl_ref)} got={int(nl_got)} data_equal={ok_d}")
+    return ok_n and ok_d, layout, data, rscal, cap
+
+
+def main():
+    ok = True
+    for (n, start, count, seed) in [
+        (100_000, 0, 100_000, 0),
+        (100_000, 12345, 54321, 1),
+        (100_000, 99_000, 1000, 2),
+        (100_000, 7, 3, 3),
+        (1_000_000, 0, 1_000_000, 4),
+        (1_000_000, 333_333, 444_444, 5),
+    ]:
+        good, layout, data, rscal, cap = check(n, 28, start, count,
+                                               feat=seed % 28, thr=120,
+                                               seed=seed)
+        ok = ok and good
+    print("ALL OK" if ok else "MISMATCH")
+
+    # throughput at a big window
+    n = 8 * 1024 * 1024
+    rng = np.random.RandomState(9)
+    codes = rng.randint(0, 250, size=(n, 28)).astype(np.uint8)
+    layout = plane.make_layout(28, 1, n, with_label=True, with_score=True)
+    cpl = plane.build_codes_planes(jnp.asarray(codes), layout)
+    data = plane.build_data(layout, cpl,
+                            jnp.asarray(rng.randn(n).astype(np.float32)),
+                            jnp.asarray(rng.rand(n).astype(np.float32)))
+    cap = layout.num_lanes - layout.tile
+    rscal = plane.route_scalars(layout, 5, 120, 1, 249)
+    d, nl = plane.partition_pallas(data, layout, 0, n, rscal, cap=cap)
+    jax.block_until_ready(d)
+    ts = []
+    for i in range(6):
+        rs2 = plane.route_scalars(layout, 5 + (i % 3), 100 + i, 1, 249)
+        t0 = time.perf_counter()
+        d, nl = plane.partition_pallas(data, layout, i, n - 2 * i, rs2,
+                                       cap=cap)
+        jax.block_until_ready(d)
+        ts.append(time.perf_counter() - t0)
+    med = float(np.median(ts))
+    print(f"kernel @ {n} rows (P={layout.num_planes}): {med*1e3:.1f} ms "
+          f"-> {med/n*1e9:.2f} ns/row")
+
+
+if __name__ == "__main__":
+    main()

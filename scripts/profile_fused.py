@@ -37,12 +37,12 @@ def main():
     t0 = time.time()
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1,
                     verbose_eval=False, keep_training_booster=True)
-    jax.block_until_ready(bst._gbdt.train_score.score)
+    jax.block_until_ready(bst._gbdt.device_score_state())
     print(f"first iter (compile+run): {time.time() - t0:.1f}s")
 
     t0 = time.time()
     bst.update()
-    jax.block_until_ready(bst._gbdt.train_score.score)
+    jax.block_until_ready(bst._gbdt.device_score_state())
     print(f"steady iter: {time.time() - t0:.3f}s")
 
     tdir = "/tmp/fused_trace"
@@ -50,7 +50,7 @@ def main():
     with jax.profiler.trace(tdir):
         for _ in range(2):
             bst.update()
-        jax.block_until_ready(bst._gbdt.train_score.score)
+        jax.block_until_ready(bst._gbdt.device_score_state())
 
     files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
     if not files:
