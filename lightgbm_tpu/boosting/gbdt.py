@@ -110,6 +110,18 @@ class GBDT:
         self._score_dirty = False    # train_score stale vs _fused_state
         if fused_supported(config, train_data, objective):
             self._fused = FusedSerialGrower(train_data, config, objective)
+        elif config.tree_learner == "data" and len(jax.devices()) > 1:
+            # fused single-dispatch iterations sharded over the device
+            # mesh (persistent path only; the host-loop parallel grower
+            # above stays as the fallback for everything else)
+            import copy as _copy
+            cfg_serial = _copy.copy(config)
+            cfg_serial.tree_learner = "serial"
+            if fused_supported(cfg_serial, train_data, objective):
+                from ..treelearner.parallel import FusedDataParallelGrower
+                mc = FusedDataParallelGrower(train_data, config, objective)
+                if mc.persistent_capable:
+                    self._fused = mc
         # persistent single-program iterations: pointwise objective, one
         # tree per iteration, no bagging/GOSS/RF/DART score surgery
         self._fused_persist = (
@@ -117,6 +129,11 @@ class GBDT:
             and self._fused._score_from_partition
             and self.num_tree_per_iteration == 1
             and config.boosting == "gbdt" and type(self) is GBDT)
+        if getattr(self._fused, "is_multichip", False) \
+                and not self._fused_persist:
+            # the sharded fused grower only implements the persistent
+            # path; everything else runs the host-loop parallel learner
+            self._fused = None
         self._fused_check_every = 10
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
@@ -270,7 +287,10 @@ class GBDT:
                 # custom fobj supplies gradients in row order: leave the
                 # persistent state and fall through to the per-tree path
                 self._invalidate_fused_state()
-            return self._train_one_iter_fused(init_scores)
+            if not getattr(self._fused, "is_multichip", False):
+                return self._train_one_iter_fused(init_scores)
+            # multichip fused grower has no per-tree path: host-loop
+            # parallel learner handles custom-gradient iterations
 
         should_continue = False
         for c in range(k):

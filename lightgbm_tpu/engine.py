@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -37,6 +38,25 @@ def _resolve_early_stopping(params: Dict[str, Any],
     return explicit
 
 
+def _ensure_jit_cache() -> None:
+    """Persistent XLA compile cache shared by every entry point (train,
+    cv, bench): fold 2..k of a cv() and repeat runs of the same shapes
+    skip compilation entirely. Respects a user-configured cache dir."""
+    import jax
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return
+        cache = os.environ.get(
+            "LGBM_TPU_JIT_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "lightgbm_tpu", "xla"))
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100, valid_sets=None, valid_names=None,
           fobj=None, feval=None, init_model=None, feature_name: str = "auto",
@@ -46,9 +66,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
           keep_training_booster: bool = False, callbacks=None) -> Booster:
     """reference engine.py:18."""
     params = copy.deepcopy(params) if params else {}
+    _ensure_jit_cache()
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
+    from .utils.timer import global_timer
+    if not os.environ.get("LGBM_TPU_TIMETAG"):
+        # reference -DUSE_TIMETAG phase table (common.h:1054): opt-in
+        # via the env knob or verbose>=2 (assign BOTH ways so a quiet
+        # train after a verbose one stops paying the annotations)
+        global_timer.enabled =             int(params.get("verbose", params.get("verbosity", 1)) or 0) >= 2
+
     early_stopping_rounds = _resolve_early_stopping(params, early_stopping_rounds)
     first_metric_only = params.get("first_metric_only", False)
 
@@ -78,7 +106,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set.init_score = init_score.T.reshape(-1) if init_score.ndim == 2 \
             else init_score
 
-    booster = Booster(params=params, train_set=train_set)
+    with global_timer.scope("dataset construction + learner build"):
+        booster = Booster(params=params, train_set=train_set)
     if predictor_model is not None:
         k = predictor_model._gbdt.num_tree_per_iteration
         from .basic import copy_tree
@@ -133,15 +162,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                         iteration=i, begin_iteration=0,
                                         end_iteration=num_boost_round,
                                         evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+        with global_timer.scope("boosting iteration (device dispatch)"):
+            finished = booster.update(fobj=fobj)
 
         evaluation_result_list = []
-        if valid_contain_train:
-            evaluation_result_list.extend(
-                (train_data_name, m, v, b)
-                for _, m, v, b in booster.eval_train(feval))
-        if booster.name_valid_sets:
-            evaluation_result_list.extend(booster.eval_valid(feval))
+        with global_timer.scope("metric evaluation"):
+            if valid_contain_train:
+                evaluation_result_list.extend(
+                    (train_data_name, m, v, b)
+                    for _, m, v, b in booster.eval_train(feval))
+            if booster.name_valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
         try:
             for cb in callbacks_after:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
@@ -158,7 +189,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # fused path trains blind between periodic stop checks; drop any
     # trailing all-degenerate iterations it may have accumulated
     if getattr(booster._gbdt, "_fused", None) is not None:
-        booster._gbdt._trim_degenerate_tail()
+        with global_timer.scope("degenerate-tail check (device sync)"):
+            booster._gbdt._trim_degenerate_tail()
+    if global_timer.enabled and global_timer.acc:
+        from .utils import log as _log
+        _log.info("%s", global_timer.report())
+        global_timer.reset()   # per-train tables; also avoids the
+        # atexit re-print of already-reported scopes
 
     for ds_name, m_name, val, _ in (evaluation_result_list or []):
         booster.best_score.setdefault(ds_name, collections.OrderedDict())
@@ -283,6 +320,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        callbacks=None, eval_train_metric: bool = False,
        return_cvbooster: bool = False):
     """reference engine.py:394."""
+    _ensure_jit_cache()
     params = copy.deepcopy(params) if params else {}
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     early_stopping_rounds = _resolve_early_stopping(params, early_stopping_rounds)

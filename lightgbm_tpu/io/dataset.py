@@ -214,6 +214,32 @@ class BinnedDataset:
         self._device_bins = None
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _find_bin_mappers_local(sample_col_nonzeros, total_features: int,
+                                sample_cnt: int, config: Config,
+                                cat_set) -> List["BinMapper"]:
+        """Single-machine per-feature bin finding
+        (DatasetLoader::ConstructBinMappers, dataset_loader.cpp:527)."""
+        mappers: List[BinMapper] = []
+        for f in range(total_features):
+            _, col = sample_col_nonzeros(f)
+            nonzero = col[(np.abs(col) > K_ZERO_THRESHOLD) | np.isnan(col)]
+            m = BinMapper()
+            if config.max_bin_by_feature and f < len(config.max_bin_by_feature):
+                mb = config.max_bin_by_feature[f]
+            else:
+                mb = config.max_bin
+            m.find_bin(nonzero, sample_cnt, mb,
+                       min_data_in_bin=config.min_data_in_bin,
+                       min_split_data=config.min_data_in_leaf,
+                       pre_filter=config.feature_pre_filter,
+                       bin_type=(BIN_CATEGORICAL if f in cat_set
+                                 else BIN_NUMERICAL),
+                       use_missing=config.use_missing,
+                       zero_as_missing=config.zero_as_missing)
+            mappers.append(m)
+        return mappers
+
     @classmethod
     def from_matrix(cls, data: np.ndarray, config: Config,
                     label: Optional[np.ndarray] = None,
@@ -295,23 +321,17 @@ class BinnedDataset:
             return np.arange(sample_cnt), col
 
         # --- per-feature bin finding ---
-        mappers: List[BinMapper] = []
-        for f in range(total_features):
-            _, col = sample_col_nonzeros(f)
-            nonzero = col[(np.abs(col) > K_ZERO_THRESHOLD) | np.isnan(col)]
-            m = BinMapper()
-            if config.max_bin_by_feature and f < len(config.max_bin_by_feature):
-                mb = config.max_bin_by_feature[f]
-            else:
-                mb = config.max_bin
-            m.find_bin(nonzero, sample_cnt, mb,
-                       min_data_in_bin=config.min_data_in_bin,
-                       min_split_data=config.min_data_in_leaf,
-                       pre_filter=config.feature_pre_filter,
-                       bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
-                       use_missing=config.use_missing,
-                       zero_as_missing=config.zero_as_missing)
-            mappers.append(m)
+        if config.num_machines > 1 and not sparse_input:
+            # distributed construction protocol: round-robin row shards,
+            # per-machine owned-feature binning, mapper allgather over
+            # the mesh (reference dataset_loader.cpp:917-990)
+            from .distributed import distributed_find_bin_mappers
+            mappers = distributed_find_bin_mappers(
+                np.asarray(sample, dtype=np.float64), config, cat_set)
+        else:
+            mappers = cls._find_bin_mappers_local(
+                sample_col_nonzeros, total_features, sample_cnt, config,
+                cat_set)
 
         used = [f for f in range(total_features) if not mappers[f].is_trivial]
         if not used:

@@ -157,3 +157,48 @@ def merge_gathered_mappers(gathered: np.ndarray,
         log.fatal("Distributed bin finding left features without "
                   "mappers: %s", missing)
     return mappers
+
+
+def distributed_find_bin_mappers(sample: np.ndarray, config: Config,
+                                 cat_set=frozenset()) -> List[BinMapper]:
+    """The full num_machines>1 construction protocol, single-controller
+    driven (reference ConstructBinMappersFromTextData,
+    dataset_loader.cpp:917-990):
+
+    1. pre_partition=false row ROUND-ROBIN: machine r owns sample rows
+       r, r+world, r+2*world, ... (dataset_loader.cpp:167),
+    2. each machine bins its OWNED feature subset from its local rows
+       (scaled by the global sample count),
+    3. the serialized mappers ride an all-gather over the device mesh
+       (Network::Allgather at :984 -> jax.lax.all_gather over ICI),
+    4. every rank merges the identical full mapper set.
+
+    Boundaries differ slightly from single-machine construction (each
+    feature sees 1/world of the sample) — exactly the reference's
+    distributed semantics.
+    """
+    import jax
+
+    world = int(config.num_machines)
+    n, f_total = sample.shape
+    shards = [np.asarray(sample[r::world], dtype=np.float64)
+              for r in range(world)]
+    pairs = [construct_bin_mappers_distributed(
+        shards[r], r, world, config, cat_set, total_sample_cnt=n)
+        for r in range(world)]
+    bufs = [serialize_mappers(p) for p in pairs]
+    pad = -(-max(len(b) for b in bufs) // 128) * 128
+    stacked = np.stack([np.pad(b, (0, pad - len(b))) for b in bufs])
+    ndev = len(jax.devices())
+    if ndev >= world:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:world]), ("data",))
+        gathered = allgather_bytes(stacked, mesh)
+    else:
+        # fewer devices than machines (e.g. single-chip run of a
+        # num_machines config): the collective degenerates to the
+        # already-assembled buffer — protocol output is identical
+        log.info("num_machines=%d > %d devices: bin-mapper allgather "
+                 "runs host-side", world, ndev)
+        gathered = stacked
+    return merge_gathered_mappers(gathered, f_total)

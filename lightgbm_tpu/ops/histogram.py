@@ -362,60 +362,68 @@ def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
     prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
     i = pl.program_id(0)
-    x = data_ref[...]                              # [P, Rb] i32
-    off, count = scal[1], scal[2]
-    pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
-    valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
 
-    gh = jax.lax.bitcast_convert_type(
-        x[grad_plane:grad_plane + 2, :], jnp.float32)
-    g_t = (gh[0:1, :] * valid).astype(dtype)
-    h_t = (gh[1:2, :] * valid).astype(dtype)
+    # blocks past the leaf range contribute nothing: skip their compute
+    # entirely (their index_map is pinned to the last active block, so
+    # the pipeline does not even refetch them)
+    @pl.when(i <= scal[3])
+    def _active():
+        x = data_ref[...]                          # [P, Rb] i32
+        off, count = scal[1], scal[2]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
+        valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
 
-    # unpack feature code rows from the packed planes: k codes per
-    # plane, feature f = plane*k + j at byte j*code_bytes (ops/plane.py
-    # little-endian packing)
-    k = 4 // code_bytes
-    mask = (1 << (8 * code_bytes)) - 1
-    Fp = C * Fc
-    npl = Fp // k
-    planes = x[0:npl, :]
-    e = jnp.broadcast_to(planes[:, None, :], (npl, k, Rb)) \
-        .reshape(npl * k, Rb)
-    sh = (jax.lax.broadcasted_iota(jnp.int32, (Fp, 1), 0) % k) \
-        * (8 * code_bytes)
-    ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fp, Rb]
+        gh = jax.lax.bitcast_convert_type(
+            x[grad_plane:grad_plane + 2, :], jnp.float32)
+        g_t = (gh[0:1, :] * valid).astype(dtype)
+        h_t = (gh[1:2, :] * valid).astype(dtype)
 
-    lo_t = (ct & (Bl - 1)).astype(dtype)
-    hi_t = (ct >> bl_bits).astype(dtype)
+        # unpack feature code rows from the packed planes: k codes per
+        # plane, feature f = plane*k + j at byte j*code_bytes
+        # (ops/plane.py little-endian packing)
+        k = 4 // code_bytes
+        mask = (1 << (8 * code_bytes)) - 1
+        Fp = C * Fc
+        npl = Fp // k
+        planes = x[0:npl, :]
+        e = jnp.broadcast_to(planes[:, None, :], (npl, k, Rb)) \
+            .reshape(npl * k, Rb)
+        sh = (jax.lax.broadcasted_iota(jnp.int32, (Fp, 1), 0) % k) \
+            * (8 * code_bytes)
+        ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fp, Rb]
 
-    fcl, fch = Fc * Bl, Fc * Bh
-    ex_lo = (jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 0) // Bl ==
-             jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 1)).astype(dtype)
-    slot_lo = (jax.lax.broadcasted_iota(
-        jnp.int32, (fcl, 1), 0) % Bl).astype(jnp.float32)
-    ex_hi = (jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 0) // Bh ==
-             jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 1)).astype(dtype)
-    slot_hi = (jax.lax.broadcasted_iota(
-        jnp.int32, (fch, 1), 0) % Bh).astype(jnp.float32)
+        lo_t = (ct & (Bl - 1)).astype(dtype)
+        hi_t = (ct >> bl_bits).astype(dtype)
 
-    for c in range(C):
-        lo_c = lo_t[c * Fc:(c + 1) * Fc, :]
-        hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
-        mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
-                 == slot_lo).astype(dtype)
-        mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
-                 == slot_hi)
-        ag = mhi_t.astype(dtype) * g_t
-        ah = mhi_t.astype(dtype) * h_t
-        pg = jax.lax.dot_general(
-            ag, mlo_t, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)
-        ph = jax.lax.dot_general(
-            ah, mlo_t, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)
-        out_ref[c, 0:fch, :] += pg
-        out_ref[c, fch:2 * fch, :] += ph
+        fcl, fch = Fc * Bl, Fc * Bh
+        ex_lo = (jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 0) // Bl ==
+                 jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 1)) \
+            .astype(dtype)
+        slot_lo = (jax.lax.broadcasted_iota(
+            jnp.int32, (fcl, 1), 0) % Bl).astype(jnp.float32)
+        ex_hi = (jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 0) // Bh ==
+                 jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 1)) \
+            .astype(dtype)
+        slot_hi = (jax.lax.broadcasted_iota(
+            jnp.int32, (fch, 1), 0) % Bh).astype(jnp.float32)
+
+        for c in range(C):
+            lo_c = lo_t[c * Fc:(c + 1) * Fc, :]
+            hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
+            mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
+                     == slot_lo).astype(dtype)
+            mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
+                     == slot_hi)
+            ag = mhi_t.astype(dtype) * g_t
+            ah = mhi_t.astype(dtype) * h_t
+            pg = jax.lax.dot_general(
+                ag, mlo_t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec)
+            ph = jax.lax.dot_general(
+                ah, mlo_t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec)
+            out_ref[c, 0:fch, :] += pg
+            out_ref[c, fch:2 * fch, :] += ph
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
@@ -451,12 +459,16 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     start = jnp.asarray(start, jnp.int32)
     rs_blk = jnp.clip(start // Rb, 0, R // Rb - nblk)
     off = start - rs_blk * Rb
-    scal = jnp.stack([rs_blk, off, jnp.asarray(count, jnp.int32)])
+    count = jnp.asarray(count, jnp.int32)
+    last_rel = jnp.maximum(off + count - 1, 0) // Rb
+    scal = jnp.stack([rs_blk, off, count, last_rel])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nblk,),
-        in_specs=[pl.BlockSpec((P, Rb), lambda i, scal: (0, scal[0] + i))],
+        in_specs=[pl.BlockSpec(
+            (P, Rb),
+            lambda i, scal: (0, scal[0] + jnp.minimum(i, scal[3])))],
         out_specs=pl.BlockSpec((C, 2 * Fc * Bh, Fc * Bl),
                                lambda i, scal: (0, 0, 0)),
         scratch_shapes=[],

@@ -158,11 +158,16 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
 # routing scalars
 # ---------------------------------------------------------------------------
 
+ROUTE_SCALARS = 19      # routing vector length (see route_scalars)
+CAT_WORDS = 8           # bitset words -> categorical bins <= 256
+
+
 def route_scalars(layout: PlaneLayout, feature, threshold, default_left,
-                  miss_bin, efb_dev=None):
-    """i32 scalar vector describing one numerical split's routing, for
-    both the kernel (prefetched) and the oracle. Layout:
-    [plane, shift, mask, thr, dl, miss, efb_use, efb_off, efb_nsl, efb_skip]
+                  miss_bin, efb_dev=None, is_cat=None, cat_bitset=None):
+    """i32 scalar vector describing one split's routing, for both the
+    kernel (prefetched) and the oracle. Layout:
+    [plane, shift, mask, thr, dl, miss, efb_use, efb_off, efb_nsl,
+     efb_skip, is_cat, bitset_w0..w7]
     """
     feature = jnp.asarray(feature, jnp.int32)
     cb = layout.code_bytes
@@ -178,25 +183,45 @@ def route_scalars(layout: PlaneLayout, feature, threshold, default_left,
     plane = byte // 4
     shift = 8 * (byte % 4)
     mask = jnp.int32(255 if cb == 1 else 65535)
-    return jnp.stack([plane, shift, mask,
-                      jnp.asarray(threshold, jnp.int32),
-                      jnp.asarray(default_left, jnp.int32),
-                      jnp.asarray(miss_bin, jnp.int32), *efb])
+    ic = jnp.asarray(0 if is_cat is None else is_cat, jnp.int32)
+    if cat_bitset is None:
+        bits = jnp.zeros(CAT_WORDS, jnp.int32)
+    else:
+        bits = jnp.asarray(cat_bitset, jnp.int32)
+        bits = jnp.pad(bits, (0, CAT_WORDS - bits.shape[0]))
+    return jnp.concatenate([
+        jnp.stack([plane, shift, mask,
+                   jnp.asarray(threshold, jnp.int32),
+                   jnp.asarray(default_left, jnp.int32),
+                   jnp.asarray(miss_bin, jnp.int32), *efb, ic]), bits])
 
 
 def _route_from_col32(col32, rs):
     """Shared routing math: packed plane word -> go_left (bool), given
     the scalar vector rs (see route_scalars). All intermediates stay
-    int32 — Mosaic cannot select/broadcast i1 vectors."""
+    int32 — Mosaic cannot select/broadcast i1 vectors.
+
+    Categorical routing (rs[10] == 1) is bitset membership over the 8
+    prefetched words (dense_bin.hpp Split categorical case): the word
+    is selected by a masked sum, the bit by a per-lane variable shift
+    — no gather. Missing categoricals ignore default_left (they are
+    out-of-set -> right), mirroring ops/partition._decision_go_left."""
     code = jax.lax.shift_right_logical(col32, rs[1]) & rs[2]
     rel = code - rs[7]
     inband = ((rel >= 0) & (rel < rs[8])).astype(jnp.int32)
     dec = rel + (rel >= rs[9]).astype(jnp.int32)
     efb_bin = jnp.where(inband == 1, dec, rs[9])
     binval = jnp.where(rs[6] == 1, efb_bin, code)
-    go_left = (binval <= rs[3]).astype(jnp.int32)
-    is_miss = ((binval == rs[5]) & (rs[5] >= 0)).astype(jnp.int32)
-    return jnp.where(is_miss == 1, rs[4], go_left) == 1
+    num_left = (binval <= rs[3]).astype(jnp.int32)
+    widx = jax.lax.shift_right_logical(binval, 5)
+    word = jnp.zeros_like(binval)
+    for w in range(CAT_WORDS):
+        word = word + jnp.where(widx == w, rs[11 + w], 0)
+    cat_left = jax.lax.shift_right_logical(word, binval & 31) & 1
+    dec_lr = jnp.where(rs[10] == 1, cat_left, num_left)
+    is_miss = ((binval == rs[5]) & (rs[5] >= 0)
+               & (rs[10] == 0)).astype(jnp.int32)
+    return jnp.where(is_miss == 1, rs[4], dec_lr) == 1
 
 
 # ---------------------------------------------------------------------------
@@ -268,15 +293,21 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
     side = pl.program_id(0)
     t = pl.program_id(1)
     nt = pl.num_programs(1)
+    t0 = scal[3]
+    t1 = scal[4]
     step = side * nt + t
 
     @pl.when(step == 0)
     def _():
-        smem[0] = 0     # lefts seen
-        smem[1] = 0     # written lanes (128-aligned)
-        smem[2] = 0     # carry length in [0, 128)
+        smem[0] = 0          # lefts seen
+        smem[1] = t0 * S     # written lanes (S-aligned stream start)
+        smem[2] = 0          # carry length in [0, 128)
+        smem[3] = 0          # active stream steps taken
 
-    @pl.when(side <= 1)
+    # blocks outside [t0, t1] hold only pre/tail rows whose stream
+    # positions equal their original positions — identity, skipped on
+    # every side (their index_map is pinned so nothing is refetched)
+    @pl.when((side <= 1) & (t >= t0) & (t <= t1))
     def _stream():
         x = data_ref[...]                      # [P, S] i32
         off = scal[0]
@@ -285,9 +316,9 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
         valid = (pos >= off) & (pos < off + count)
 
         col32 = jnp.sum(jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (P, S), 0) == scal[3], x, 0),
+            jax.lax.broadcasted_iota(jnp.int32, (P, S), 0) == scal[5], x, 0),
             axis=0, keepdims=True)
-        rsv = [scal[3 + i] for i in range(10)]
+        rsv = [scal[5 + i] for i in range(ROUTE_SCALARS)]
         go_left = _route_from_col32(col32, rsv)
 
         keep_l = ((pos < off) | (valid & go_left)).astype(jnp.int32)
@@ -312,7 +343,10 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
 
         c = smem[2]
         written = pl.multiple_of(smem[1], 128)
-        slot = jax.lax.rem(step, 2)
+        # slot alternation must follow ACTIVE steps (skipped blocks do
+        # not run): parity of an SMEM counter, not of the grid step
+        asteps = smem[3]
+        slot = jax.lax.rem(asteps, 2)
         c_inv = jax.lax.rem(128 - c, 128)
 
         # two buffers so this step's build overlaps the previous step's
@@ -322,7 +356,7 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
             stg0[:, :S] = comp
             stg0[:, S:] = pltpu.roll(cbuf[...], c_inv, 1)
             stg0[...] = pltpu.roll(stg0[...], c, 1)
-            @pl.when(step > 0)
+            @pl.when(asteps > 0)
             def _():
                 pltpu.make_async_copy(
                     stg1, win_ref.at[:, pl.ds(0, S + 128)], sems.at[1]).wait()
@@ -351,8 +385,9 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
         smem[0] = smem[0] + nl_here
         smem[1] = written + adv
         smem[2] = newc
+        smem[3] = asteps + 1
 
-        @pl.when(step == 2 * nt - 1)
+        @pl.when((side == 1) & (t == t1))
         def _():
             @pl.when(slot == 0)
             def _():
@@ -364,11 +399,11 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
                     stg1, win_ref.at[:, pl.ds(0, S + 128)], sems.at[1]).wait()
 
     # ---- side 2: window -> data write-back (HBM-to-HBM block DMAs) ---
-    @pl.when(side == 2)
+    @pl.when((side == 2) & (t >= t0) & (t <= t1))
     def _writeback():
         rs_blk = scal[2]
         slot2 = jax.lax.rem(t, 2)
-        @pl.when(t > 1)
+        @pl.when(t > t0 + 1)
         def _():
             pltpu.make_async_copy(
                 win_ref.at[:, pl.ds(0, S)],
@@ -377,12 +412,12 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
             win_ref.at[:, pl.ds(t * S, S)],
             dout_ref.at[:, pl.ds((rs_blk + t) * S, S)],
             wsems.at[slot2]).start()
-        @pl.when(t == nt - 1)
+        @pl.when(t == t1)
         def _():
             pltpu.make_async_copy(
                 win_ref.at[:, pl.ds(0, S)],
                 dout_ref.at[:, pl.ds(0, S)], wsems.at[slot2]).wait()
-            @pl.when(nt > 1)
+            @pl.when(t1 > t0)
             def _():
                 pltpu.make_async_copy(
                     win_ref.at[:, pl.ds(0, S)],
@@ -406,16 +441,21 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
     rs_blk = jnp.clip(jnp.asarray(start, jnp.int32) // S, 0, R // S - nt)
     rs = rs_blk * S
     off = jnp.asarray(start, jnp.int32) - rs
-    # kernel scalar layout: [off, count, rs_blk, <10 routing scalars>]
+    count = jnp.asarray(count, jnp.int32)
+    t0 = off // S
+    t1 = jnp.maximum(off + count - 1, 0) // S
+    # kernel scalar layout: [off, count, rs_blk, t0, t1, <10 routing>]
     kern_scal = jnp.concatenate([
-        jnp.stack([off, jnp.asarray(count, jnp.int32), rs_blk]),
+        jnp.stack([off, count, rs_blk, t0, t1]),
         rscal.astype(jnp.int32)])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(3, nt),
         in_specs=[pl.BlockSpec(
-            (P, S), lambda side, t, scal: (0, scal[2] + t * (side < 2)))],
+            (P, S),
+            lambda side, t, scal: (0, scal[2] + jnp.clip(t, scal[3],
+                                                         scal[4])))],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),

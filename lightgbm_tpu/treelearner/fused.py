@@ -28,13 +28,15 @@ tree MUST run inside one compiled program:
   scatters; scores are scattered back to row order only when a host
   consumer asks (GBDT.get_training_score).
 
-Coverage: numerical features, serial learner, any objective without
-leaf renewal, bagging via a host-provided permutation, per-tree
-feature_fraction, max_depth, basic monotone constraints, L1/L2/
-max_delta_step/path smoothing. Categorical features, forced splits,
-interaction constraints, feature_fraction_bynode, CEGB and
-renew-tree-output objectives fall back to the host-loop grower
-(treelearner/serial.py).
+Coverage: numerical AND categorical features (one-vs-rest + sorted
+many-vs-many with the left-set bitset materialized on device and
+routed through the partition kernel's prefetched scalars), serial and
+sharded-data-parallel learners, any objective without leaf renewal,
+bagging via a host-provided permutation, per-tree feature_fraction,
+max_depth, basic monotone constraints, L1/L2/max_delta_step/path
+smoothing. Forced splits, interaction constraints,
+feature_fraction_bynode, CEGB and renew-tree-output objectives fall
+back to the host-loop grower (treelearner/serial.py).
 """
 from __future__ import annotations
 
@@ -62,7 +64,10 @@ def fused_supported(config: Config, dataset: BinnedDataset,
     """Static eligibility check for the fused path."""
     if config.tree_learner != "serial":
         return False
-    if any(m.bin_type == BIN_CATEGORICAL for m in dataset.bin_mappers):
+    if max((m.num_bin for m in dataset.bin_mappers
+            if m.bin_type == BIN_CATEGORICAL), default=0) > 256:
+        # categorical routing carries an 8-word (256-bin) bitset through
+        # the partition kernel's prefetched scalars
         return False
     if config.forcedsplits_filename or config.interaction_constraints:
         return False
@@ -89,8 +94,9 @@ class FusedTreeState(NamedTuple):
     """Loop-carried device state; [L] = num_leaves slots."""
     data: jax.Array            # [P, R] planar training rows
     n_leaves: jax.Array        # scalar i32
-    leaf_start: jax.Array      # [L]
-    leaf_count: jax.Array      # [L]
+    leaf_start: jax.Array      # [L] shard-local window starts
+    leaf_count: jax.Array      # [L] shard-local window lengths
+    leaf_count_g: jax.Array    # [L] GLOBAL row counts (== local 1-chip)
     leaf_sum_g: jax.Array      # [L]
     leaf_sum_h: jax.Array      # [L]
     leaf_output: jax.Array     # [L]
@@ -111,6 +117,8 @@ class FusedTreeState(NamedTuple):
     best_rh: jax.Array         # [L]
     best_rcnt: jax.Array       # [L]
     best_rout: jax.Array       # [L]
+    best_cat: jax.Array        # [L] bool — categorical split
+    best_bits: jax.Array       # [L, 8] left-category bin bitset
     hist_pool: jax.Array       # [L, F, B, 2]
     # tree under construction (internal nodes [L-1])
     t_feature: jax.Array
@@ -122,14 +130,19 @@ class FusedTreeState(NamedTuple):
     t_ivalue: jax.Array
     t_iweight: jax.Array
     t_icount: jax.Array
+    t_cat: jax.Array           # [L-1] bool
+    t_bits: jax.Array          # [L-1, 8]
 
 
 class FusedSerialGrower:
     """Builds and owns the single-dispatch training-iteration program."""
 
+    is_multichip = False
+
     def __init__(self, dataset: BinnedDataset, config: Config,
-                 objective=None) -> None:
+                 objective=None, num_rows_override=None) -> None:
         self.dataset = dataset
+        self._num_rows_override = num_rows_override
         self.config = config
         self.objective = objective
         self.bins = dataset.device_bins()
@@ -140,13 +153,15 @@ class FusedSerialGrower:
         monotone = [dataset.monotone_constraint(i)
                     for i in range(self.num_features)]
         self.use_monotone = any(m != 0 for m in monotone)
+        self.any_categorical = any(m.bin_type == BIN_CATEGORICAL
+                                   for m in mappers)
         penalty = list(config.feature_contri) + \
             [1.0] * (self.num_features - len(config.feature_contri))
         self.meta = S.FeatureMeta.build(
             num_bin=[m.num_bin for m in mappers],
             missing_type=[m.missing_type for m in mappers],
             default_bin=[m.default_bin for m in mappers],
-            is_categorical=[False] * self.num_features,
+            is_categorical=[m.bin_type == BIN_CATEGORICAL for m in mappers],
             monotone=monotone,
             penalty=[float(p) for p in penalty[:self.num_features]])
         self.split_cfg = S.SplitConfig(
@@ -156,7 +171,11 @@ class FusedSerialGrower:
             min_gain_to_split=config.min_gain_to_split,
             max_delta_step=config.max_delta_step,
             path_smooth=config.path_smooth,
-            use_monotone=self.use_monotone)
+            use_monotone=self.use_monotone,
+            max_cat_threshold=config.max_cat_threshold,
+            cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_per_group=config.min_data_per_group)
         self.feature_miss_bin = jnp.asarray([
             (m.num_bin - 1 if m.missing_type == 2 else
              (m.default_bin if m.missing_type == 1 else -1))
@@ -182,7 +201,8 @@ class FusedSerialGrower:
         # objective can run the persistent in-program loop
         self._num_cols = int(self.bins.shape[1])
         self._code_bytes = int(np.dtype(self.bins.dtype).itemsize)
-        n = dataset.num_data
+        n = (dataset.num_data if num_rows_override is None
+             else num_rows_override)
         persist = (objective is not None
                    and getattr(objective, "persistent_aux", None) is not None
                    and objective.persistent_aux() is not None
@@ -220,6 +240,9 @@ class FusedSerialGrower:
             or config.boosting in ("goss", "rf"))
         self._score_from_partition = not bag_active
 
+        # multi-chip: name of the mesh axis to psum histograms/counts
+        # over (set by the data-parallel wrapper; None on one chip)
+        self.psum_axis = None
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         # capacity ladder for the lax.switch partition/histogram
         # branches, in lane-tile units. Factor 4 keeps the program small
@@ -251,6 +274,14 @@ class FusedSerialGrower:
         idx = jnp.searchsorted(cap_arr, jnp.maximum(count, 1))
         idx = jnp.minimum(idx, len(self._caps) - 1)
         return jax.lax.switch(idx, branches, *args)
+
+    def _psum(self, x):
+        """Cross-shard sum (reference Network::Allreduce of histogram
+        buffers, data_parallel_tree_learner.cpp:169) — identity on one
+        chip."""
+        if self.psum_axis is None:
+            return x
+        return jax.lax.psum(x, self.psum_axis)
 
     def _window_hist(self, b, g, h):
         """Histogram of bin codes with masked weights; EFB bundle
@@ -310,12 +341,14 @@ class FusedSerialGrower:
 
         return self._switch_by_cap(count, branch, data, start, count)
 
-    def _split_step(self, data, start, count, feature, thr, dl, miss_bin):
+    def _split_step(self, data, start, count, feature, thr, dl, miss_bin,
+                    cat=None, bits=None):
         """Split one leaf: the carry-stream partition kernel moves its
         rows (ops/plane.py), then the smaller child's histogram comes
         from the freshly contiguous range at its own capacity bucket."""
         rscal = plane.route_scalars(self.layout, feature, thr, dl, miss_bin,
-                                    self._efb_dev)
+                                    self._efb_dev, is_cat=cat,
+                                    cat_bitset=bits)
 
         def branch(cap):
             def fn(data, start, count, rscal):
@@ -326,23 +359,29 @@ class FusedSerialGrower:
 
         data, nleft = self._switch_by_cap(count, branch, data, start, count,
                                           rscal)
-        left_smaller = nleft <= count - nleft
-        s_start = jnp.where(left_smaller, start, start + nleft)
-        s_count = jnp.where(left_smaller, nleft, count - nleft)
-        hist_small = self._leaf_hist_switch(data, s_start, s_count)
-        return data, nleft, hist_small
+        return data, nleft
 
     def _scan_leaf(self, hist, sum_g, sum_h, count, output, cmin, cmax,
                    feature_mask):
-        """Best split of one leaf from its pooled histogram."""
-        res = S.numerical_split_scan(hist, self.meta, self.split_cfg,
-                                     sum_g, sum_h, count, output, cmin, cmax)
+        """Best split of one leaf from its pooled histogram; categorical
+        features go through the merged numerical+categorical scan and
+        materialize their left-category bitset HERE (the device
+        analogue of serial.py _cat_bins), so the loop state only
+        carries [8] words per leaf, not the full sorted order."""
+        if self.any_categorical:
+            res = S.best_split(hist, self.meta, self.split_cfg, sum_g,
+                               sum_h, count, output, cmin, cmax,
+                               any_categorical=True)
+        else:
+            res = S.numerical_split_scan(hist, self.meta, self.split_cfg,
+                                         sum_g, sum_h, count, output,
+                                         cmin, cmax)
         gains = jnp.where(feature_mask, res["gain"], S.K_MIN_SCORE)
         f = jnp.argmax(gains).astype(jnp.int32)
         g = gains[f]
         ok = jnp.isfinite(g) & (g > 0.0) \
             & (count >= 2 * self.split_cfg.min_data_in_leaf)
-        return dict(
+        out = dict(
             gain=jnp.where(ok, g, NEG_INF),
             feature=f,
             thr=res["threshold"][f],
@@ -351,6 +390,37 @@ class FusedSerialGrower:
             lcnt=res["left_count"][f], lout=res["left_output"][f],
             rg=res["right_sum_gradient"][f], rh=res["right_sum_hessian"][f],
             rcnt=res["right_count"][f], rout=res["right_output"][f])
+        if self.any_categorical:
+            out["cat"] = self.meta.is_categorical[f]
+            out["bits"] = self._cat_bitset_device(res, f)
+        else:
+            out["cat"] = jnp.bool_(False)
+            out["bits"] = jnp.zeros(8, jnp.int32)
+        return out
+
+    def _cat_bitset_device(self, res, f):
+        """[8] i32 left-category bin bitset from the categorical scan's
+        (family, position, sorted order, used) description — family 0 is
+        the single one-vs-rest bin, 1/2 are prefix/suffix of the sorted
+        order (feature_histogram.hpp:278 one-hot and directional scans;
+        host-side mirror: serial.py _cat_bins)."""
+        fam = res["cat_family"][f]
+        pos = jnp.asarray(res["threshold"][f], jnp.int32)
+        order = res["cat_sorted_order"][f].astype(jnp.int32)   # [B]
+        used = res["cat_used_bin"][f]
+        B = order.shape[0]
+        idx = jnp.arange(B, dtype=jnp.int32)
+        sel_fwd = idx <= pos
+        sel_bwd = (idx >= used - 1 - pos) & (idx < used)
+        sel = jnp.where(fam == 1, sel_fwd, sel_bwd) & (fam != 0)
+        bins_eff = jnp.where(fam == 0, pos, order)
+        sel = sel | ((fam == 0) & (idx == 0))
+        bit = jnp.left_shift(jnp.int32(1), bins_eff & 31)
+        words = []
+        for w in range(8):
+            words.append(jnp.sum(jnp.where(
+                sel & ((bins_eff >> 5) == w), bit, 0)))
+        return jnp.stack(words)
 
     def _scan_two_leaves(self, hist2, sum_g2, sum_h2, count2, output2,
                          cmin2, cmax2, feature_mask):
@@ -372,10 +442,12 @@ class FusedSerialGrower:
         F, B = self.num_features, self.max_num_bin
         f32, i32 = jnp.float32, jnp.int32
 
-        root_hist = self._leaf_hist_switch(data, jnp.int32(0), bag_cnt)
+        root_hist = self._psum(self._leaf_hist_switch(data, jnp.int32(0),
+                                                      bag_cnt))
+        bag_cnt_g = self._psum(jnp.asarray(bag_cnt, i32))
         sum_g = jnp.sum(root_hist[0, :, 0])
         sum_h = jnp.sum(root_hist[0, :, 1])
-        root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt,
+        root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt_g,
                                     f32(0.0), f32(-jnp.inf), f32(jnp.inf),
                                     feature_mask)
 
@@ -386,6 +458,7 @@ class FusedSerialGrower:
             data=data, n_leaves=i32(1),
             leaf_start=arr(0, i32).at[0].set(0),
             leaf_count=arr(0, i32).at[0].set(bag_cnt),
+            leaf_count_g=arr(0, i32).at[0].set(bag_cnt_g),
             leaf_sum_g=arr(0.0).at[0].set(sum_g),
             leaf_sum_h=arr(0.0).at[0].set(sum_h),
             leaf_output=arr(0.0),
@@ -404,6 +477,8 @@ class FusedSerialGrower:
             best_rh=arr(0.0).at[0].set(root_best["rh"]),
             best_rcnt=arr(0, i32).at[0].set(root_best["rcnt"]),
             best_rout=arr(0.0).at[0].set(root_best["rout"]),
+            best_cat=arr(False, bool).at[0].set(root_best["cat"]),
+            best_bits=jnp.zeros((L, 8), i32).at[0].set(root_best["bits"]),
             hist_pool=(jnp.zeros((L, F, B, 2), f32).at[0].set(root_hist)
                        if self._use_hist_pool
                        else jnp.zeros((1, 1, 1, 2), f32)),
@@ -416,6 +491,8 @@ class FusedSerialGrower:
             t_ivalue=jnp.zeros((L - 1,), f32),
             t_iweight=jnp.zeros((L - 1,), f32),
             t_icount=jnp.zeros((L - 1,), i32),
+            t_cat=jnp.zeros((L - 1,), bool),
+            t_bits=jnp.zeros((L - 1, 8), i32),
         )
 
         max_depth = self.config.max_depth
@@ -439,6 +516,8 @@ class FusedSerialGrower:
             thr = st.best_thr[leaf]
             dl = st.best_dl[leaf]
             miss = self.feature_miss_bin[feat]
+            cat = st.best_cat[leaf]
+            bits = st.best_bits[leaf]
 
             # --- tree bookkeeping (Tree::Split semantics, tree.h:61) ---
             parent = st.leaf_parent[leaf]
@@ -458,14 +537,28 @@ class FusedSerialGrower:
             t_gain = st.t_gain.at[node].set(st.best_gain[leaf])
             t_ivalue = st.t_ivalue.at[node].set(st.leaf_output[leaf])
             t_iweight = st.t_iweight.at[node].set(st.leaf_sum_h[leaf])
-            t_icount = st.t_icount.at[node].set(st.leaf_count[leaf])
+            t_icount = st.t_icount.at[node].set(st.leaf_count_g[leaf])
+            t_cat = st.t_cat.at[node].set(cat)
+            t_bits = st.t_bits.at[node].set(bits)
 
-            # --- partition + smaller-child histogram ---
+            # --- shard-local partition; counts reduced globally ---
             start = st.leaf_start[leaf]
             count = st.leaf_count[leaf]
-            new_data, nleft, hist_small = self._split_step(
-                st.data, start, count, feat, thr, dl, miss)
+            count_g = st.leaf_count_g[leaf]
+            new_data, nleft = self._split_step(
+                st.data, start, count, feat, thr, dl, miss,
+                cat=cat, bits=bits)
             nright = count - nleft
+            nleft_g = self._psum(nleft)
+            nright_g = count_g - nleft_g
+
+            # smaller child by GLOBAL count — every shard must histogram
+            # the same child for the psum + subtraction to be coherent
+            left_smaller = nleft_g <= nright_g
+            s_start = jnp.where(left_smaller, start, start + nleft)
+            s_count = jnp.where(left_smaller, nleft, nright)
+            hist_small = self._psum(
+                self._leaf_hist_switch(new_data, s_start, s_count))
 
             # --- children bookkeeping ---
             lout, rout = st.best_lout[leaf], st.best_rout[leaf]
@@ -484,6 +577,8 @@ class FusedSerialGrower:
             leaf_start = st.leaf_start.at[new_leaf].set(start + nleft)
             leaf_count = st.leaf_count.at[leaf].set(nleft)\
                                        .at[new_leaf].set(nright)
+            leaf_count_g = st.leaf_count_g.at[leaf].set(nleft_g)\
+                                          .at[new_leaf].set(nright_g)
             leaf_sum_g = st.leaf_sum_g.at[leaf].set(st.best_lg[leaf])\
                                       .at[new_leaf].set(st.best_rg[leaf])
             leaf_sum_h = st.leaf_sum_h.at[leaf].set(st.best_lh[leaf])\
@@ -499,7 +594,6 @@ class FusedSerialGrower:
 
             # --- larger child: subtraction from the pooled parent (or a
             # second contiguous-slice histogram when pool-less) ---
-            left_smaller = nleft <= nright
             if self._use_hist_pool:
                 hist_large = st.hist_pool[leaf] - hist_small
                 hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -509,8 +603,8 @@ class FusedSerialGrower:
             else:
                 l_start = jnp.where(left_smaller, start + nleft, start)
                 l_count = jnp.where(left_smaller, nright, nleft)
-                hist_large = self._leaf_hist_switch(new_data, l_start,
-                                                    l_count)
+                hist_large = self._psum(
+                    self._leaf_hist_switch(new_data, l_start, l_count))
                 hist_left = jnp.where(left_smaller, hist_small, hist_large)
                 hist_right = jnp.where(left_smaller, hist_large, hist_small)
                 hist_pool = st.hist_pool
@@ -520,7 +614,7 @@ class FusedSerialGrower:
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([st.best_lg[leaf], st.best_rg[leaf]]),
                 jnp.stack([st.best_lh[leaf], st.best_rh[leaf]]),
-                jnp.stack([nleft, nright]),
+                jnp.stack([nleft_g, nright_g]),
                 jnp.stack([lout, rout]),
                 jnp.stack([lcmin, rcmin]),
                 jnp.stack([lcmax, rcmax]), feature_mask)
@@ -531,6 +625,7 @@ class FusedSerialGrower:
             return FusedTreeState(
                 data=new_data, n_leaves=st.n_leaves + 1,
                 leaf_start=leaf_start, leaf_count=leaf_count,
+                leaf_count_g=leaf_count_g,
                 leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
                 leaf_output=leaf_output, leaf_depth=leaf_depth,
                 leaf_parent=leaf_parent, leaf_cmin=leaf_cmin,
@@ -545,10 +640,14 @@ class FusedSerialGrower:
                 best_rg=upd(st.best_rg, "rg"), best_rh=upd(st.best_rh, "rh"),
                 best_rcnt=upd(st.best_rcnt, "rcnt"),
                 best_rout=upd(st.best_rout, "rout"),
+                best_cat=upd(st.best_cat, "cat"),
+                best_bits=st.best_bits.at[leaf].set(bl["bits"])
+                                      .at[new_leaf].set(br["bits"]),
                 hist_pool=hist_pool,
                 t_feature=t_feature, t_thr=t_thr, t_dl=t_dl, t_left=t_left,
                 t_right=t_right, t_gain=t_gain, t_ivalue=t_ivalue,
                 t_iweight=t_iweight, t_icount=t_icount,
+                t_cat=t_cat, t_bits=t_bits,
             )
 
         st = jax.lax.while_loop(cond, body, st)
@@ -560,16 +659,23 @@ class FusedSerialGrower:
             split_gain=st.t_gain, internal_value=st.t_ivalue,
             internal_weight=st.t_iweight, internal_count=st.t_icount,
             leaf_value=st.leaf_output, leaf_weight=st.leaf_sum_h,
-            leaf_count=st.leaf_count, leaf_depth=st.leaf_depth,
+            leaf_count=st.leaf_count_g, leaf_depth=st.leaf_depth,
+            split_cat=st.t_cat, split_bits=st.t_bits,
         )
         return tree_arrays, st
 
     # ------------------------------------------------------------------
     def _pos_leaf_terms(self, st: FusedTreeState):
-        """Sorted leaf-window starts + sort order (tiny [L] work)."""
+        """Sorted leaf-window starts + sort order (tiny [L] work).
+
+        Leaves with a zero LOCAL count are excluded: they share their
+        start with a sibling window (empty range), and a duplicate
+        start would make the rank-among-starts trick attribute the
+        rows to the empty leaf — bites on shards that hold no rows of
+        some leaf (non-IID data-parallel sharding)."""
         L = self.num_leaves
         lid = jnp.arange(L, dtype=jnp.int32)
-        valid = lid < st.n_leaves
+        valid = (lid < st.n_leaves) & (st.leaf_count > 0)
         starts = jnp.where(valid, st.leaf_start,
                            jnp.int32(self.layout.num_lanes) + 1)
         order = jnp.argsort(starts)
@@ -662,15 +768,18 @@ class FusedSerialGrower:
             weight=(None if aux_weight is None
                     else jnp.asarray(aux_weight, jnp.float32)))
 
-    def _train_iter(self, data, feature_mask, shrinkage, bias):
+    def _train_iter(self, data, feature_mask, shrinkage, bias,
+                    n_valid=None):
         """One full boosting iteration in ONE program: gradients from
         the in-state score, tree growth, and the score update — all in
         leaf-permuted lane order (GBDT::TrainOneIter, gbdt.cpp:337,
-        minus the host loop)."""
+        minus the host loop). ``n_valid`` overrides the static row
+        count (traced, for per-shard row counts under shard_map)."""
         Ly = self.layout
-        n = Ly.num_rows
+        n = jnp.int32(Ly.num_rows) if n_valid is None \
+            else jnp.asarray(n_valid, jnp.int32)
         lanes = jnp.arange(Ly.num_lanes, dtype=jnp.int32)
-        realm = lanes < jnp.int32(n)  # pad lanes never enter any window
+        realm = lanes < n  # pad lanes never enter any window
 
         score = plane.get_f32(data, Ly.score)
         label = plane.get_f32(data, Ly.label)
@@ -680,7 +789,7 @@ class FusedSerialGrower:
         h = jnp.where(realm, h, 0.0)
         data = plane.set_gh(data, Ly, g, h)
 
-        ta, st = self._grow_tree_core(data, jnp.int32(n), feature_mask)
+        ta, st = self._grow_tree_core(data, n, feature_mask)
 
         vals = ta["leaf_value"] * shrinkage
         add = self._score_add_by_pos(st, vals.astype(jnp.float32))
@@ -741,6 +850,12 @@ class FusedSerialGrower:
             go_left = b <= thr
             is_missing = (b == mb) & (mb >= 0)
             go_left = jnp.where(is_missing, ta["default_left"][nid], go_left)
+            if self.any_categorical:
+                words = ta["split_bits"][nid]          # [N, 8]
+                word = jnp.take_along_axis(
+                    words, (b >> 5)[:, None], axis=1)[:, 0]
+                cat_left = ((word >> (b & 31)) & 1) == 1
+                go_left = jnp.where(ta["split_cat"][nid], cat_left, go_left)
             nxt = jnp.where(go_left, ta["left_child"][nid],
                             ta["right_child"][nid])
             return jnp.where(node < 0, node, nxt)
@@ -778,14 +893,42 @@ class FusedSerialGrower:
         tree.split_feature_inner[:ni] = inner_feat
         tree.split_feature[:ni] = [real_idx[f] for f in inner_feat]
         tree.threshold_in_bin[:ni] = ta["threshold_bin"][:ni]
-        tree.threshold[:ni] = [mappers[f].bin_to_value(int(tb))
-                               for f, tb in zip(inner_feat,
-                                                ta["threshold_bin"][:ni])]
+        cat_flags = ta.get("split_cat")
+        tree.threshold[:ni] = [
+            0.0 if (cat_flags is not None and bool(cat_flags[i]))
+            else mappers[f].bin_to_value(int(tb))
+            for i, (f, tb) in enumerate(zip(inner_feat,
+                                            ta["threshold_bin"][:ni]))]
+        from ..models.tree import K_CATEGORICAL_MASK, _to_bitset
         dt = np.zeros(max(ni, 1), dtype=np.int8)
+        cat_nodes = ta.get("split_cat")
         for i, f in enumerate(inner_feat):
-            v = (2 if ta["default_left"][i] else 0) | \
-                ((mappers[f].missing_type & 3) << 2)
-            dt[i] = v
+            if cat_nodes is not None and bool(cat_nodes[i]):
+                # reconstruct the left-category sets from the device
+                # bitset (Tree::Split categorical case, tree.cpp:70-91)
+                words = np.asarray(ta["split_bits"][i], dtype=np.uint32)
+                bin_set = [b for b in range(mappers[f].num_bin)
+                           if (words[b >> 5] >> (b & 31)) & 1]
+                cat_vals = sorted(
+                    mappers[f].bin_2_categorical[b] for b in bin_set
+                    if mappers[f].bin_2_categorical[b] >= 0)
+                dt[i] = np.int8(np.uint8(
+                    K_CATEGORICAL_MASK
+                    | ((mappers[f].missing_type & 3) << 2)))
+                tree.threshold_in_bin[i] = tree.num_cat
+                tree.threshold[i] = tree.num_cat
+                tree.num_cat += 1
+                bits_inner = _to_bitset(bin_set)
+                bits_raw = _to_bitset(cat_vals)
+                tree.cat_boundaries_inner.append(
+                    tree.cat_boundaries_inner[-1] + len(bits_inner))
+                tree.cat_threshold_inner.extend(bits_inner)
+                tree.cat_boundaries.append(
+                    tree.cat_boundaries[-1] + len(bits_raw))
+                tree.cat_threshold.extend(bits_raw)
+            else:
+                dt[i] = np.int8((2 if ta["default_left"][i] else 0) |
+                                ((mappers[f].missing_type & 3) << 2))
         tree.decision_type[:ni] = dt[:ni]
         tree.left_child[:ni] = ta["left_child"][:ni]
         tree.right_child[:ni] = ta["right_child"][:ni]

@@ -45,6 +45,7 @@ from ..ops.partition import next_capacity
 from ..ops.partition import _decision_go_left
 from ..utils import log
 from .serial import SerialTreeGrower, _Leaf
+from .fused import FusedSerialGrower, fused_supported
 
 
 def build_mesh(config: Config) -> Mesh:
@@ -408,6 +409,116 @@ class FeatureParallelTreeGrower(SerialTreeGrower):
     def _split_packed(self, hist, *args):
         hist = jax.lax.with_sharding_constraint(hist, self._hist_sharding)
         return super()._split_packed(hist, *args)
+
+
+class FusedDataParallelGrower(FusedSerialGrower):
+    """Fused single-dispatch iterations under `shard_map` — the
+    data-parallel learner for the persistent training path.
+
+    Reference analogue: data_parallel_tree_learner.cpp, but instead of
+    a ReduceScatter of histogram buffers per LEAF over sockets
+    (:169), the whole `lax.while_loop` tree build runs per shard with
+    one `psum` of the smaller child's histogram (and of the split
+    counts) per split riding ICI. Rows are sharded contiguously over
+    the 1-D "data" mesh axis; each shard partitions only its own rows
+    and carries its own leaf windows, while split decisions are made
+    on the psum'd (global) histograms — bitwise identical on every
+    shard, so the resulting tree is replicated by construction (the
+    reference's SyncUpGlobalBestSplit, :240, becomes a no-op).
+    """
+
+    is_multichip = True
+
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 objective=None, mesh: Optional[Mesh] = None) -> None:
+        self.mesh = mesh if mesh is not None else build_mesh(config)
+        self.num_shards = int(self.mesh.shape["data"])
+        self.global_rows = dataset.num_data
+        shard_rows = -(-dataset.num_data // self.num_shards)
+        super().__init__(dataset, config, objective,
+                         num_rows_override=shard_rows)
+        self.shard_rows = shard_rows
+        self.psum_axis = "data"
+        n = self.global_rows
+        counts = [max(0, min(n - d * shard_rows, shard_rows))
+                  for d in range(self.num_shards)]
+        self._n_per_shard = jax.device_put(
+            jnp.asarray(counts, jnp.int32),
+            NamedSharding(self.mesh, P("data")))
+        self._iter_mc_jit = None
+
+    # -- sharded state construction ------------------------------------
+    def _shard_lane_pad(self, v, fill=0.0, dtype=jnp.float32):
+        """[n] global -> [D * num_lanes] with per-shard lane padding."""
+        D, sr, Ly = self.num_shards, self.shard_rows, self.layout
+        v = jnp.asarray(v, dtype)
+        v = jnp.pad(v, (0, D * sr - v.shape[0]), constant_values=fill)
+        v = v.reshape(D, sr)
+        v = jnp.pad(v, ((0, 0), (0, Ly.num_lanes - sr)),
+                    constant_values=fill)
+        return v.reshape(-1)
+
+    def init_persistent_state(self, score_vec) -> jax.Array:
+        assert self.persistent_capable
+        from ..ops import plane
+        D, sr, Ly = self.num_shards, self.shard_rows, self.layout
+        aux_label, aux_weight = self.objective.persistent_aux()
+        n = self.global_rows
+        bins_pad = jnp.pad(self.bins, ((0, D * sr - n), (0, 0)))
+        shards = []
+        for d in range(D):
+            cp = plane.build_codes_planes(
+                bins_pad[d * sr:(d + 1) * sr], Ly)
+            rowid = jnp.arange(d * sr, (d + 1) * sr, dtype=jnp.int32)
+            # pad rows alias row id n -> dropped by the sync scatter
+            rowid = jnp.where(rowid < n, rowid, n)
+            rowid = jnp.pad(rowid, (0, Ly.num_lanes - sr),
+                            constant_values=n)
+            zero = jnp.zeros(Ly.num_lanes, jnp.float32)
+            shards.append(plane.build_data(
+                Ly, cp, zero, zero, rowid=rowid))
+        data = jnp.concatenate(shards, axis=1)
+        lab = self._shard_lane_pad(aux_label)
+        sc = self._shard_lane_pad(jnp.asarray(score_vec, jnp.float32))
+        data = data.at[Ly.label].set(plane.f32_as_i32(lab))
+        data = data.at[Ly.score].set(plane.f32_as_i32(sc))
+        if Ly.weight >= 0:
+            data = data.at[Ly.weight].set(
+                plane.f32_as_i32(self._shard_lane_pad(aux_weight)))
+        return jax.device_put(
+            data, NamedSharding(self.mesh, P(None, "data")))
+
+    # -- sharded iteration ---------------------------------------------
+    def train_iter_persistent(self, data, shrinkage, bias):
+        if self._iter_mc_jit is None:
+            def body(data_l, nvalid_l, mask, shr, b):
+                return self._train_iter(data_l, mask, shr, b,
+                                        n_valid=nvalid_l[0])
+            f = functools.partial(
+                shard_map, mesh=self.mesh, check_vma=False,
+                in_specs=(P(None, "data"), P("data"), P(), P(), P()),
+                out_specs=(P(None, "data"), P()))(body)
+            self._iter_mc_jit = jax.jit(f, donate_argnums=0)
+        return self._iter_mc_jit(data, self._n_per_shard,
+                                 self.feature_mask_tree(),
+                                 jnp.float32(shrinkage), jnp.float32(bias))
+
+    def _sync_scores(self, data):
+        from ..ops import plane
+        Ly = self.layout
+        n = self.global_rows
+
+        def body(data_l):
+            rowids = data_l[Ly.rowid]
+            score = plane.get_f32(data_l, Ly.score)
+            out = jnp.zeros(n, jnp.float32).at[rowids].set(
+                score, mode="drop", unique_indices=True)
+            return jax.lax.psum(out, "data")
+
+        return functools.partial(
+            shard_map, mesh=self.mesh, check_vma=False,
+            in_specs=(P(None, "data"),), out_specs=P())(body)(data)
+
 
 
 def create_parallel_learner(kind: str, dataset: BinnedDataset,

@@ -53,6 +53,10 @@ class Timer:
             lines.append(f"  {name}: {self.acc[name]:.3f}s over {self.cnt[name]} calls")
         return "\n".join(lines)
 
+    def reset(self) -> None:
+        self.acc.clear()
+        self.cnt.clear()
+
     def print_at_exit(self) -> None:
         if self.enabled and self.acc:
             log.info("%s", self.report())

