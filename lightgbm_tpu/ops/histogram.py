@@ -352,7 +352,7 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 
 def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
-                         bl_bits, dtype, code_bytes, grad_plane, Rb):
+                         bl_bits, dtype, code_bits, grad_plane, Rb):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -379,17 +379,17 @@ def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
         h_t = (gh[1:2, :] * valid).astype(dtype)
 
         # unpack feature code rows from the packed planes: k codes per
-        # plane, feature f = plane*k + j at byte j*code_bytes
-        # (ops/plane.py little-endian packing)
-        k = 4 // code_bytes
-        mask = (1 << (8 * code_bytes)) - 1
+        # plane, feature f = plane*k + j at bit j*code_bits
+        # (ops/plane.py little-endian packing; 4-bit = IS_4BIT analogue)
+        k = 32 // code_bits
+        mask = (1 << code_bits) - 1
         Fp = C * Fc
         npl = Fp // k
         planes = x[0:npl, :]
         e = jnp.broadcast_to(planes[:, None, :], (npl, k, Rb)) \
             .reshape(npl * k, Rb)
         sh = (jax.lax.broadcasted_iota(jnp.int32, (Fp, 1), 0) % k) \
-            * (8 * code_bytes)
+            * code_bits
         ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fp, Rb]
 
         lo_t = (ct & (Bl - 1)).astype(dtype)
@@ -427,11 +427,11 @@ def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
-                                             "code_bytes", "grad_plane",
+                                             "code_bits", "grad_plane",
                                              "cap", "dtype",
                                              "rows_per_block", "interpret"))
 def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
-                            num_cols: int, code_bytes: int, grad_plane: int,
+                            num_cols: int, code_bits: int, grad_plane: int,
                             cap: int, dtype=jnp.float32,
                             rows_per_block: int = 512,
                             interpret: bool = False) -> jax.Array:
@@ -449,8 +449,8 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
     Fc = max(1, 128 // Bl)
-    # chunks must cover whole planes: Fc*code_bytes multiple of 4
-    while (Fc * code_bytes) % 4:
+    # chunks must cover whole planes: Fc*code_bits multiple of 32
+    while (Fc * code_bits) % 32:
         Fc *= 2
     C = -(-num_cols // Fc)
     nblk = cap // Rb + 1
@@ -476,7 +476,7 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     out = pl.pallas_call(
         functools.partial(_radix_planar_kernel, C=C, Fc=Fc, Bh=Bh, Bl=Bl,
                           bl_bits=bl_bits, dtype=dtype,
-                          code_bytes=code_bytes, grad_plane=grad_plane,
+                          code_bits=code_bits, grad_plane=grad_plane,
                           Rb=Rb),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((C, 2 * Fc * Bh, Fc * Bl),

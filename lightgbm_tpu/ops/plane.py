@@ -52,8 +52,10 @@ DEF_TILE = 2048
 class PlaneLayout(NamedTuple):
     """Plane indices of the [P, R] int32 training-state array."""
     num_cols: int        # G bundle columns
-    code_bytes: int      # bytes per bin code (1 or 2)
-    code_planes: int     # ceil(G*cb / 4)
+    code_bits: int       # bits per bin code (4, 8 or 16) — 4-bit is the
+                         # reference's DenseBin IS_4BIT packing
+                         # (dense_bin.hpp:17-21) for <=16-bin features
+    code_planes: int     # ceil(G*bits / 32)
     grad: int
     hess: int
     rowid: int
@@ -66,10 +68,11 @@ class PlaneLayout(NamedTuple):
     tile: int
 
 
-def make_layout(num_cols: int, code_bytes: int, n: int,
+def make_layout(num_cols: int, code_bits: int, n: int,
                 with_label: bool = False, with_score: bool = False,
                 with_weight: bool = False, tile: int = DEF_TILE) -> PlaneLayout:
-    cp = -(-num_cols * code_bytes // 4)
+    assert code_bits in (4, 8, 16)
+    cp = -(-num_cols * code_bits // 32)
     p = cp
     grad, hess = p, p + 1
     p += 2
@@ -87,7 +90,7 @@ def make_layout(num_cols: int, code_bytes: int, n: int,
         p += 1
     num_planes = -(-p // 8) * 8
     num_lanes = (-(-n // tile) + 1) * tile
-    return PlaneLayout(num_cols, code_bytes, cp, grad, hess, rowid,
+    return PlaneLayout(num_cols, code_bits, cp, grad, hess, rowid,
                        label, score, weight, num_planes, n, num_lanes, tile)
 
 
@@ -101,10 +104,16 @@ def i32_as_f32(x):
 
 def build_codes_planes(codes: jax.Array, layout: PlaneLayout) -> jax.Array:
     """[n, G] u8/u16 bin codes -> [code_planes, R] i32 (little-endian
-    byte packing: column j lives at byte j*cb % 4 of plane j*cb // 4)."""
+    packing: column j occupies bits [j*bits % 32, ...) of plane
+    j*bits // 32; 4-bit mode packs two columns per byte)."""
     n, g = codes.shape
-    cb = layout.code_bytes
-    if cb == 1:
+    bits = layout.code_bits
+    if bits == 4:
+        c = codes.astype(jnp.uint8)
+        if g % 2:
+            c = jnp.pad(c, ((0, 0), (0, 1)))
+        b = (c[:, 0::2] & 15) | (c[:, 1::2] << 4)
+    elif bits == 8:
         b = codes.astype(jnp.uint8)
     else:
         b = jax.lax.bitcast_convert_type(
@@ -170,7 +179,7 @@ def route_scalars(layout: PlaneLayout, feature, threshold, default_left,
      efb_skip, is_cat, bitset_w0..w7]
     """
     feature = jnp.asarray(feature, jnp.int32)
-    cb = layout.code_bytes
+    bits = layout.code_bits
     if efb_dev is not None:
         group_of, offset_of, nslots_of, skip_of = efb_dev
         gidx = group_of[feature]
@@ -179,10 +188,10 @@ def route_scalars(layout: PlaneLayout, feature, threshold, default_left,
     else:
         gidx = feature
         efb = [jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)]
-    byte = gidx * cb
-    plane = byte // 4
-    shift = 8 * (byte % 4)
-    mask = jnp.int32(255 if cb == 1 else 65535)
+    bitpos = gidx * bits
+    plane = bitpos // 32
+    shift = bitpos % 32
+    mask = jnp.int32((1 << bits) - 1)
     ic = jnp.asarray(0 if is_cat is None else is_cat, jnp.int32)
     if cat_bitset is None:
         bits = jnp.zeros(CAT_WORDS, jnp.int32)
@@ -505,7 +514,11 @@ def window_rowmajor(data: jax.Array, layout: PlaneLayout, rs, *, cap: int):
     cw = jax.lax.dynamic_slice(data, (0, rs), (cp, cap))
     b = jax.lax.bitcast_convert_type(cw, jnp.uint8)       # [C, cap, 4]
     rm = jnp.transpose(b, (1, 0, 2)).reshape(cap, cp * 4)
-    if layout.code_bytes == 1:
+    if layout.code_bits == 4:
+        half = rm[:, :(layout.num_cols + 1) // 2]
+        codes = jnp.stack([half & 15, half >> 4],
+                          axis=2).reshape(cap, -1)[:, :layout.num_cols]
+    elif layout.code_bits == 8:
         codes = rm[:, :layout.num_cols]
     else:
         codes = jax.lax.bitcast_convert_type(

@@ -198,9 +198,18 @@ class FusedSerialGrower:
             self._part_method = "ref"
 
         # planar layout: label/score/weight planes only when the
-        # objective can run the persistent in-program loop
+        # objective can run the persistent in-program loop. Codes pack
+        # at 4 bits when every (bundle) column fits 16 bins — the
+        # reference's DenseBin IS_4BIT mode (dense_bin.hpp:17-21),
+        # halving code-plane HBM footprint and partition bandwidth.
         self._num_cols = int(self.bins.shape[1])
-        self._code_bytes = int(np.dtype(self.bins.dtype).itemsize)
+        group_bins = (dataset.group_max_bins
+                      if dataset.device_hist_tables() is not None
+                      else self.max_num_bin)
+        if group_bins <= 16:
+            self._code_bits = 4
+        else:
+            self._code_bits = 8 * int(np.dtype(self.bins.dtype).itemsize)
         n = (dataset.num_data if num_rows_override is None
              else num_rows_override)
         persist = (objective is not None
@@ -209,7 +218,7 @@ class FusedSerialGrower:
                    and objective.num_tree_per_iteration == 1)
         has_w = persist and objective.persistent_aux()[1] is not None
         self.layout = plane.make_layout(
-            self._num_cols, self._code_bytes, n,
+            self._num_cols, self._code_bits, n,
             with_label=persist, with_score=persist, with_weight=has_w)
         self.persistent_capable = persist
         self._codes_planes_dev = None   # built lazily
@@ -312,9 +321,9 @@ class FusedSerialGrower:
         # ensure the padding never reads past the plane count
         bh_bits, bl_bits = H._radix_dims(nbins)
         fc = max(1, 128 // (1 << bl_bits))
-        while (fc * Ly.code_bytes) % 4:
+        while (fc * Ly.code_bits) % 32:
             fc *= 2
-        npl = (-(-Ly.num_cols // fc)) * fc * Ly.code_bytes // 4
+        npl = (-(-Ly.num_cols // fc)) * fc * Ly.code_bits // 32
         planar_ok = (self._hist_method is not None
                      and npl <= Ly.num_planes)
         dtype = (jnp.bfloat16 if self._hist_method == "radix_pallas_bf16"
@@ -325,7 +334,7 @@ class FusedSerialGrower:
                 if planar_ok:
                     ghist = H.histogram_planar_pallas(
                         data, start, count, num_bins=nbins,
-                        num_cols=Ly.num_cols, code_bytes=Ly.code_bytes,
+                        num_cols=Ly.num_cols, code_bits=Ly.code_bits,
                         grad_plane=Ly.grad, cap=cap, dtype=dtype)
                     return self._hist_from_groups(ghist)
                 rs = jnp.clip(jnp.asarray(start, jnp.int32), 0, R - cap)

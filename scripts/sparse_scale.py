@@ -1,0 +1,154 @@
+"""Wide-sparse scale proof: the Allstate shape (13.2M rows x 4000
+sparse binary features, ~95% sparse) trained end-to-end on one chip.
+
+The reference trains Allstate in 148.2s/500 iters on the CPU box with
+1.1 GB RAM (docs/Experiments.rst:121,174) — the shape's hazard for the
+TPU build is HBM: naive dense u8 storage would be 13.2M x 4000 = 53 GB.
+The pipeline that makes it fit:
+  raw CSR -> EFB bundling (4000 one-hot columns -> ~500 bundle
+  columns) -> 4-bit planar code packing (group bins <= 16)
+  => ~250 B/row of codes instead of 4000.
+
+Run on the TPU chip:  python scripts/sparse_scale.py
+Env: SPARSE_ROWS (default 13_200_000), SPARSE_VARS (default 500; 8
+one-hot categories each -> 4000 columns), SPARSE_ITERS (default 10).
+
+Writes docs/SPARSE_SCALE.md with the measured footprint + AUC sanity.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("SPARSE_ROWS", 13_200_000))
+VARS = int(os.environ.get("SPARSE_VARS", 500))
+CATS = 8
+ITERS = int(os.environ.get("SPARSE_ITERS", 10))
+
+
+def make_sparse(n, nvars, ncats, seed=0):
+    """One-hot design matrix in CSR: nvars categorical variables of
+    ncats levels each -> nvars*ncats binary columns, exactly one
+    nonzero per variable per row (the Allstate-like structure EFB
+    exploits)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(seed)
+    # skewed category popularity so bundles get a dominant bin
+    probs = rng.dirichlet(np.ones(ncats) * 0.7, size=nvars)
+    cats = np.empty((n, nvars), dtype=np.int16)
+    for v in range(nvars):
+        cats[:, v] = rng.choice(ncats, size=n, p=probs[v])
+    w = rng.randn(nvars, ncats) * (rng.rand(nvars) < 0.2)[:, None]
+    logit = np.zeros(n, np.float32)
+    for v in range(nvars):
+        logit += w[v][cats[:, v]].astype(np.float32)
+    y = (logit + rng.randn(n).astype(np.float32) * 0.5 > 0).astype(np.float32)
+
+    cols = (cats + np.arange(nvars, dtype=np.int32) * ncats).astype(np.int32)
+    indptr = np.arange(n + 1, dtype=np.int64) * nvars
+    data = np.ones(n * nvars, dtype=np.float32)
+    X = sp.csr_matrix((data, cols.reshape(-1), indptr),
+                      shape=(n, nvars * ncats))
+    return X, y, cats
+
+
+def main():
+    import jax
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(repo, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    import lightgbm_tpu as lgb
+
+    t0 = time.time()
+    X, y, cats = make_sparse(ROWS, VARS, CATS)
+    t_gen = time.time() - t0
+    print(f"generated {ROWS}x{VARS * CATS} CSR "
+          f"(density {X.nnz / (ROWS * VARS * CATS):.3%}) in {t_gen:.0f}s")
+
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 20}
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t_construct = time.time() - t0
+    inner = ds._handle
+    g = inner.bins.shape[1]
+    code_bits = None
+
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, num_boost_round=ITERS,
+                    verbose_eval=False, keep_training_booster=True)
+    jax.block_until_ready(bst._gbdt.device_score_state())
+    t_train = time.time() - t0
+    fused = bst._gbdt._fused
+    layout = fused.layout if fused is not None else None
+    code_bits = layout.code_bits if layout else None
+
+    # quality sanity vs a dense-subsample model
+    sub = np.random.RandomState(1).choice(ROWS, 200_000, replace=False)
+    p = bst.predict(X[sub])
+    ys = y[sub]
+    order = np.argsort(-p)
+    yy = ys[order] > 0
+    pos, neg = yy.sum(), len(yy) - yy.sum()
+    auc = 1.0 - (np.sum(np.arange(1, len(yy) + 1)[yy])
+                 - pos * (pos + 1) / 2) / (pos * neg)
+
+    # deterministic device-footprint accounting (memory_stats is not
+    # exposed through the accelerator tunnel)
+    acct = {}
+    if layout is not None:
+        acct["planar state [P,R] i32"] = layout.num_planes * layout.num_lanes * 4
+        acct["row-major bins (traverse path)"] = int(
+            np.prod(fused.bins.shape)) * fused.bins.dtype.itemsize
+        wl = (fused._caps[-1] // layout.tile + 1) * layout.tile
+        acct["partition window buffer"] = layout.num_planes * (
+            wl + layout.tile + 256) * 4
+        if fused._use_hist_pool:
+            acct["histogram pool [L,F,B,2]"] = (fused.num_leaves *
+                                                fused.num_features *
+                                                fused.max_num_bin * 2 * 4)
+    total = sum(acct.values())
+
+    lines = [
+        "# Wide-sparse scale proof (Allstate shape)",
+        "",
+        f"Config: {ROWS:,} rows x {VARS * CATS} one-hot columns "
+        f"(density {X.nnz / (ROWS * VARS * CATS):.2%}), num_leaves=255, "
+        f"max_bin=255, {ITERS} measured iterations on one TPU v5e chip.",
+        "",
+        f"- EFB bundled {VARS * CATS} columns into **{g} bundle columns**",
+        f"- planar code packing: **{code_bits}-bit** "
+        "(group bins <= 16 -> dense_bin.hpp IS_4BIT analogue)",
+        f"- dataset construct (binning + EFB + packing): {t_construct:.0f}s",
+        f"- train ({ITERS} iters incl. compile): {t_train:.0f}s",
+        f"- sampled train AUC: **{auc:.4f}** (sanity floor 0.70)",
+        "",
+        "Device-footprint accounting (deterministic, from array shapes):",
+        "",
+    ]
+    for k, v in acct.items():
+        lines.append(f"- {k}: {v / 1e9:.2f} GB")
+    lines += [
+        f"- **total: {total / 1e9:.2f} GB** of 16 GB HBM "
+        "(naive dense u8 would be "
+        f"{ROWS * VARS * CATS / 1e9:.1f} GB — does not fit)",
+        "",
+        f"Generated by scripts/sparse_scale.py; wall {time.time() - t0:.0f}s.",
+    ]
+    out = os.path.join(repo, "docs", "SPARSE_SCALE.md")
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+    assert auc > 0.70, "quality sanity failed"
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,25 @@ def test_pool_cap_matches_unlimited_fused():
 
 def test_pool_cap_matches_unlimited_serial():
     X, y = make_data()
-    # categorical feature forces the host-loop serial grower
+    # interaction constraints force the host-loop serial grower
+    # (categoricals used to, but they run fused since round 3)
+    base = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
+            "num_leaves": 31,
+            "interaction_constraints": [[0, 1, 2, 3], [4, 5, 6, 7]]}
+    b_full = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                       num_boost_round=6, verbose_eval=False)
+    b_cap = lgb.train(dict(base, histogram_pool_size=1),
+                      lgb.Dataset(X, label=y),
+                      num_boost_round=6, verbose_eval=False)
+    assert b_cap._gbdt._fused is None
+    np.testing.assert_allclose(b_cap.predict(X), b_full.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pool_cap_matches_unlimited_fused_categorical():
+    """Categoricals run the FUSED grower now; the pool-less fallback
+    must still match unlimited-pool training there."""
+    X, y = make_data()
     Xc = X.copy()
     Xc[:, 3] = np.random.RandomState(1).randint(0, 5, len(X))
     base = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
@@ -42,7 +60,6 @@ def test_pool_cap_matches_unlimited_serial():
     b_cap = lgb.train(dict(base, histogram_pool_size=1),
                       lgb.Dataset(Xc, label=y),
                       num_boost_round=6, verbose_eval=False)
-    assert b_cap._gbdt._fused is None
     np.testing.assert_allclose(b_cap.predict(Xc), b_full.predict(Xc),
                                rtol=1e-4, atol=1e-5)
 

@@ -56,11 +56,24 @@ def test_data_parallel_quality(eight_devices):
 
 
 def test_data_parallel_close_to_serial(eight_devices):
+    """The HOST-LOOP data-parallel learner vs serial. Bagging keeps the
+    comparison on the host-loop grower — the fused shard_map path that
+    `tree_learner=data` takes by default since round 3 is covered by
+    tests/test_fused_parallel.py with its own quality-parity contract."""
     X, y = make_binary(2000)
-    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20}
+    bag = {"bagging_fraction": 0.9, "bagging_freq": 1, "bagging_seed": 7}
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
+              **bag}
     b_serial = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
                          verbose_eval=False)
-    b_dp = _train_with_learner("data", X, y, rounds=5)
+    params_dp = {"objective": "binary", "verbose": -1,
+                 "tree_learner": "data", "num_machines": 8,
+                 "min_data_in_leaf": 20, **bag}
+    b_dp = lgb.train(params_dp, lgb.Dataset(X, label=y), num_boost_round=5,
+                     verbose_eval=False)
+    from lightgbm_tpu.treelearner.parallel import DataParallelTreeGrower
+    assert isinstance(b_dp._gbdt.tree_learner, DataParallelTreeGrower)
+    assert b_dp._gbdt._fused is None
     ps = b_serial.predict(X, raw_score=True)
     pd = b_dp.predict(X, raw_score=True)
     # same global histograms (modulo f32 reduction order) => nearly

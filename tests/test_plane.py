@@ -14,14 +14,14 @@ import pytest
 from lightgbm_tpu.ops import plane
 
 
-def make_state(n=5000, g=11, seed=0, code_bytes=1, tile=256):
+def make_state(n=5000, g=11, seed=0, code_bits=8, tile=256):
     rng = np.random.RandomState(seed)
-    hi = 250 if code_bytes == 1 else 1000
+    hi = {4: 15, 8: 250, 16: 1000}[code_bits]
     codes = rng.randint(0, hi, size=(n, g)).astype(
-        np.uint8 if code_bytes == 1 else np.uint16)
+        np.uint16 if code_bits == 16 else np.uint8)
     grad = rng.randn(n).astype(np.float32)
     hess = rng.rand(n).astype(np.float32) + 0.5
-    layout = plane.make_layout(g, code_bytes, n, with_label=True,
+    layout = plane.make_layout(g, code_bits, n, with_label=True,
                                with_score=True, tile=tile)
     cp = plane.build_codes_planes(jnp.asarray(codes), layout)
     data = plane.build_data(layout, cp, jnp.asarray(grad), jnp.asarray(hess),
@@ -44,10 +44,29 @@ def test_layout_roundtrip():
 
 
 def test_layout_roundtrip_u16():
-    layout, data, codes, grad, hess = make_state(code_bytes=2)
+    layout, data, codes, grad, hess = make_state(code_bits=16)
     got_codes, _ = plane.window_rowmajor(data, layout, 0,
                                          cap=layout.num_lanes)
     np.testing.assert_array_equal(np.asarray(got_codes)[:len(codes)], codes)
+
+
+def test_layout_roundtrip_4bit():
+    """IS_4BIT analogue: two codes per byte (dense_bin.hpp:17-21)."""
+    layout, data, codes, grad, hess = make_state(code_bits=4)
+    got_codes, _ = plane.window_rowmajor(data, layout, 0,
+                                         cap=layout.num_lanes)
+    np.testing.assert_array_equal(np.asarray(got_codes)[:len(codes)], codes)
+
+
+def test_partition_ref_4bit():
+    layout, data, codes, grad, hess = make_state(code_bits=4)
+    feat, thr = 3, 7
+    rscal = plane.route_scalars(layout, feat, thr, 1, -1)
+    cap = layout.num_lanes - layout.tile
+    data2, nleft = plane.partition_ref(data, layout, 123, 4000, rscal,
+                                       cap=cap)
+    binval = codes[123:4123, feat]
+    assert int(nleft) == int(np.sum(binval <= thr))
 
 
 def np_partition(codes, layout, start, count, feat, thr, dl, miss, n):
