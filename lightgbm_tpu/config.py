@@ -465,6 +465,10 @@ class Config:
     # Validated in __post_init__.
     tpu_hist_dtype: str = "bfloat16"
     tpu_rows_per_chunk: int = 0  # 0 = auto
+    # fused single-dispatch tree growth (treelearner/fused.py). True =
+    # use it whenever the config is eligible; False = always run the
+    # host-loop grower (debugging / like-for-like comparisons).
+    tpu_fused: bool = True
     num_gpu: int = 1
 
     # --- io (train file mode) ---

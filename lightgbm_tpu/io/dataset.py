@@ -322,9 +322,11 @@ class BinnedDataset:
 
         # --- per-feature bin finding ---
         if config.num_machines > 1 and not sparse_input:
-            # distributed construction protocol: round-robin row shards,
-            # per-machine owned-feature binning, mapper allgather over
-            # the mesh (reference dataset_loader.cpp:917-990)
+            # distributed construction protocol: per-rank owned-feature
+            # binning + mapper allgather over the mesh (reference
+            # dataset_loader.cpp:917-990). Single-controller mode bins
+            # over the full in-process sample, so boundaries are
+            # bit-identical to single-machine construction
             from .distributed import distributed_find_bin_mappers
             mappers = distributed_find_bin_mappers(
                 np.asarray(sample, dtype=np.float64), config, cat_set)

@@ -53,9 +53,14 @@ def partition_features(num_features: int, world: int,
 
 def find_bins_for_features(sample: np.ndarray, features: Sequence[int],
                            config: Config, total_sample_cnt: int,
-                           cat_set=frozenset()) -> List[Tuple[int, BinMapper]]:
+                           cat_set=frozenset(), pre_filter: bool = False
+                           ) -> List[Tuple[int, BinMapper]]:
     """Host-side bin finding for a feature subset over a local sample
-    (reference BinMapper::FindBin over the machine's own sample rows)."""
+    (reference BinMapper::FindBin over the machine's own sample rows).
+
+    pre_filter defaults off because on a true multi-host shard it would
+    need global stats; the single-controller driver passes the config
+    value through (its "local" sample IS the global sample)."""
     out = []
     for f in features:
         col = np.asarray(sample[:, f], dtype=np.float64)
@@ -67,7 +72,7 @@ def find_bins_for_features(sample: np.ndarray, features: Sequence[int],
         m.find_bin(nonzero, total_sample_cnt, mb,
                    min_data_in_bin=config.min_data_in_bin,
                    min_split_data=config.min_data_in_leaf,
-                   pre_filter=False,  # pre-filter needs global stats
+                   pre_filter=pre_filter,
                    bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
                    use_missing=config.use_missing,
                    zero_as_missing=config.zero_as_missing)
@@ -128,8 +133,8 @@ def allgather_bytes(shard_bufs: np.ndarray, mesh=None) -> np.ndarray:
 
 def construct_bin_mappers_distributed(
         local_sample: np.ndarray, rank: int, world: int, config: Config,
-        cat_set=frozenset(), total_sample_cnt: Optional[int] = None
-        ) -> List[Tuple[int, BinMapper]]:
+        cat_set=frozenset(), total_sample_cnt: Optional[int] = None,
+        pre_filter: bool = False) -> List[Tuple[int, BinMapper]]:
     """One rank's local half of the distributed bin-finding protocol:
     bins this rank's OWNED feature subset from its local sample and
     returns the (feature, mapper) pairs. The collective half is
@@ -142,7 +147,7 @@ def construct_bin_mappers_distributed(
     owned = partition_features(f_total, world)[rank]
     total = total_sample_cnt or len(local_sample)
     return find_bins_for_features(local_sample, owned, config, total,
-                                  cat_set)
+                                  cat_set, pre_filter=pre_filter)
 
 
 def merge_gathered_mappers(gathered: np.ndarray,
@@ -165,26 +170,31 @@ def distributed_find_bin_mappers(sample: np.ndarray, config: Config,
     driven (reference ConstructBinMappersFromTextData,
     dataset_loader.cpp:917-990):
 
-    1. pre_partition=false row ROUND-ROBIN: machine r owns sample rows
-       r, r+world, r+2*world, ... (dataset_loader.cpp:167),
-    2. each machine bins its OWNED feature subset from its local rows
-       (scaled by the global sample count),
+    1. features are ownership-partitioned across ranks,
+    2. each rank bins its OWNED feature subset,
     3. the serialized mappers ride an all-gather over the device mesh
        (Network::Allgather at :984 -> jax.lax.all_gather over ICI),
     4. every rank merges the identical full mapper set.
 
-    Boundaries differ slightly from single-machine construction (each
-    feature sees 1/world of the sample) — exactly the reference's
-    distributed semantics.
+    Unlike the reference — where each machine physically holds only a
+    round-robin row shard, so its features are binned from 1/world of
+    the sample (dataset_loader.cpp:167) — the single-controller process
+    has the ENTIRE sample in memory, so each rank bins its owned
+    features over the full sample. Bin boundaries are therefore
+    bit-identical to single-machine construction (num_machines is a
+    work-partitioning choice, not a data-quality tradeoff); only a true
+    multi-host deployment, where ranks call
+    `construct_bin_mappers_distributed` on genuinely local shards, sees
+    the reference's local-sample semantics.
     """
     import jax
 
     world = int(config.num_machines)
     n, f_total = sample.shape
-    shards = [np.asarray(sample[r::world], dtype=np.float64)
-              for r in range(world)]
+    full = np.asarray(sample, dtype=np.float64)
     pairs = [construct_bin_mappers_distributed(
-        shards[r], r, world, config, cat_set, total_sample_cnt=n)
+        full, r, world, config, cat_set, total_sample_cnt=n,
+        pre_filter=config.feature_pre_filter)
         for r in range(world)]
     bufs = [serialize_mappers(p) for p in pairs]
     pad = -(-max(len(b) for b in bufs) // 128) * 128

@@ -62,6 +62,8 @@ NEG_INF = jnp.float32(-jnp.inf)
 def fused_supported(config: Config, dataset: BinnedDataset,
                     objective) -> bool:
     """Static eligibility check for the fused path."""
+    if not config.tpu_fused:
+        return False
     if config.tree_learner != "serial":
         return False
     if max((m.num_bin for m in dataset.bin_mappers

@@ -101,6 +101,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
     def _hist_fn_sharded(self, capacity: int):
         B = self.max_num_bin
         mesh = self.mesh
+        method = self._hist_method()
 
         @jax.jit
         @functools.partial(
@@ -111,7 +112,8 @@ class DataParallelTreeGrower(SerialTreeGrower):
         def fn(bins, perm, start, count, grad, hess):
             # leading length-1 shard axis inside the body
             h = H.leaf_histogram(bins[0], perm[0], start[0], count[0],
-                                 grad[0], hess[0], capacity, B)
+                                 grad[0], hess[0], capacity, B,
+                                 method=method)
             # ReduceScatter+Allgather of the reference (:169) collapses
             # to one ICI all-reduce; feature-sharded scan is a later
             # optimization once profiling justifies psum_scatter
@@ -338,6 +340,7 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
         top_k = self.config.top_k
         meta = self.meta
         cfg = self.split_cfg
+        method = self._hist_method()
 
         @jax.jit
         @functools.partial(
@@ -347,7 +350,8 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             out_specs=P())
         def fn(bins, perm, start, count, grad, hess):
             h = H.leaf_histogram(bins[0], perm[0], start[0], count[0],
-                                 grad[0], hess[0], capacity, B)
+                                 grad[0], hess[0], capacity, B,
+                                 method=method)
             # local scan for voting (min_data divided by #machines,
             # reference :62-64)
             local_cfg = S.SplitConfig(
