@@ -496,6 +496,20 @@ def _use_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def hist_method(config) -> Optional[str]:
+    """The ONE backend/dtype histogram dispatch, shared by every learner
+    (serial, host-loop parallel, fused) — they must agree on histogram
+    precision or their trees diverge beyond f32 noise. On TPU: the
+    pallas radix kernel, bfloat16 inputs by default (the reference GPU
+    learner's single-precision histograms, gpu_use_dp=false —
+    AUC-neutral, 2x MXU rate) or float32 per tpu_hist_dtype. Other
+    backends keep the exact scatter path (the oracle) regardless."""
+    if _use_tpu():
+        return ("radix_pallas" if config.tpu_hist_dtype == "float32"
+                else "radix_pallas_bf16")
+    return None
+
+
 def histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               num_bins: int, method: Optional[str] = None) -> jax.Array:
     """Backend-dispatched histogram [F, B, 2]."""

@@ -186,18 +186,11 @@ class FusedSerialGrower:
         self._efb_dev = dataset.device_bundle_tables()
         self._efb_hist = dataset.device_hist_tables()
         self.group_max_bin = dataset.group_max_bins
-        # TPU: the pallas NT-radix kernel; bfloat16 inputs are the
-        # default (the reference GPU learner's single-precision
-        # histograms, gpu_use_dp=false — AUC-neutral, 2x MXU rate).
-        # Other backends keep the scatter path (exact oracle).
-        if jax.default_backend() == "tpu":
-            self._hist_method = ("radix_pallas"
-                                 if config.tpu_hist_dtype == "float32"
-                                 else "radix_pallas_bf16")
-            self._part_method = "pallas"
-        else:
-            self._hist_method = None
-            self._part_method = "ref"
+        # backend dispatch: ops/histogram.hist_method is the ONE shared
+        # precision choice for every learner; partition follows suit
+        self._hist_method = H.hist_method(config)
+        self._part_method = ("pallas" if self._hist_method is not None
+                             else "ref")
 
         # planar layout: label/score/weight planes only when the
         # objective can run the persistent in-program loop. Codes pack

@@ -101,7 +101,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
     def _hist_fn_sharded(self, capacity: int):
         B = self.max_num_bin
         mesh = self.mesh
-        method = self._hist_method()
+        method = H.hist_method(self.config)
 
         @jax.jit
         @functools.partial(
@@ -340,7 +340,7 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
         top_k = self.config.top_k
         meta = self.meta
         cfg = self.split_cfg
-        method = self._hist_method()
+        method = H.hist_method(self.config)
 
         @jax.jit
         @functools.partial(

@@ -175,24 +175,12 @@ class SerialTreeGrower:
             cat = jnp.zeros(2, jnp.int32)
         return vec, ivec, cat
 
-    def _hist_method(self):
-        """Backend/dtype histogram dispatch, shared by the serial AND
-        parallel learners — they must agree on histogram precision or
-        their trees diverge beyond f32 noise (round-4 parity fix). On
-        TPU: the pallas radix kernel, dtype per tpu_hist_dtype; other
-        backends keep the exact scatter path regardless."""
-        if jax.default_backend() == "tpu":
-            return ("radix_pallas"
-                    if self.config.tpu_hist_dtype == "float32"
-                    else "radix_pallas_bf16")
-        return None
-
     @functools.lru_cache(maxsize=64)
     def _hist_fn(self, capacity: int):
         B = self.max_num_bin
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
-        method = self._hist_method()
+        method = H.hist_method(self.config)
 
         @jax.jit
         def fn(bins, perm, start, count, grad, hess):

@@ -22,7 +22,7 @@ def check(n, g, start, count, feat, thr, seed, tile=2048):
     codes = rng.randint(0, 250, size=(n, g)).astype(np.uint8)
     grad = rng.randn(n).astype(np.float32)
     hess = rng.rand(n).astype(np.float32)
-    layout = plane.make_layout(g, 1, n, with_label=True, with_score=True,
+    layout = plane.make_layout(g, 8, n, with_label=True, with_score=True,
                                tile=tile)
     cp = plane.build_codes_planes(jnp.asarray(codes), layout)
     data = plane.build_data(layout, cp, jnp.asarray(grad), jnp.asarray(hess),
@@ -66,7 +66,7 @@ def main():
     n = 8 * 1024 * 1024
     rng = np.random.RandomState(9)
     codes = rng.randint(0, 250, size=(n, 28)).astype(np.uint8)
-    layout = plane.make_layout(28, 1, n, with_label=True, with_score=True)
+    layout = plane.make_layout(28, 8, n, with_label=True, with_score=True)
     cpl = plane.build_codes_planes(jnp.asarray(codes), layout)
     data = plane.build_data(layout, cpl,
                             jnp.asarray(rng.randn(n).astype(np.float32)),

@@ -1,0 +1,189 @@
+"""Production Pallas kernels vs XLA oracles, in interpret mode on CPU.
+
+The reference's core device-correctness check is GPU_DEBUG_COMPARE
+(reference src/treelearner/gpu_tree_learner.cpp:992-1030): kernel-built
+histograms compared against the host path. SURVEY §4 names it the
+pattern to keep. These tests run the SAME kernel code the TPU executes
+— partition_pallas, histogram_radix_pallas, histogram_planar_pallas —
+under pallas interpret mode, against partition_ref / histogram_scatter.
+On-device equivalents: scripts/kernel_check.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import plane
+from lightgbm_tpu.ops.histogram import (histogram_planar_pallas,
+                                        histogram_radix_pallas,
+                                        histogram_scatter)
+
+
+# ---------------------------------------------------------------------------
+# partition_pallas vs partition_ref
+# ---------------------------------------------------------------------------
+
+def _make_state(n, g, seed, code_bits=8, tile=512, max_code=250):
+    rng = np.random.RandomState(seed)
+    codes = rng.randint(0, max_code, size=(n, g)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    layout = plane.make_layout(g, code_bits, n, with_label=True,
+                               with_score=True, tile=tile)
+    cp = plane.build_codes_planes(jnp.asarray(codes), layout)
+    data = plane.build_data(layout, cp, jnp.asarray(grad), jnp.asarray(hess),
+                            label=jnp.asarray(grad),
+                            score=jnp.asarray(hess))
+    return layout, data, codes
+
+
+def _cap_for(layout, count):
+    tile = layout.tile
+    cap = -(-max(count, 1) // tile) * tile
+    return min(cap, layout.num_lanes - tile)
+
+
+@pytest.mark.parametrize("start,count,feat,thr,dl", [
+    (0, 4096, 3, 120, 0),        # full window
+    (1234, 2000, 7, 60, 1),      # interior window, default-left
+    (4000, 96, 0, 200, 0),       # tail window
+    (17, 3, 5, 10, 1),           # tiny leaf
+])
+def test_partition_pallas_interpret_matches_ref(start, count, feat, thr, dl):
+    layout, data, codes = _make_state(4096, 12, seed=start + count)
+    rscal = plane.route_scalars(layout, feat, thr, dl, miss_bin=249)
+    cap = _cap_for(layout, count)
+    ref, nl_ref = plane.partition_ref(data, layout, start, count, rscal,
+                                      cap=cap)
+    got, nl_got = plane.partition_pallas(data, layout, start, count, rscal,
+                                         cap=cap, interpret=True)
+    assert int(nl_ref) == int(nl_got)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    # independent semantic check against the raw codes: rows in
+    # [start, start+nleft) must all satisfy the split predicate
+    rowids = np.asarray(got[layout.rowid])
+    window = rowids[start:start + count]
+    code = codes[window, feat]
+    go_left = np.where(code == 249, bool(dl), code <= thr)
+    nl = int(nl_got)
+    assert go_left[:nl].all() and not go_left[nl:].any()
+
+
+def test_partition_pallas_interpret_categorical_bitset():
+    layout, data, codes = _make_state(2048, 6, seed=11)
+    bin_set = {3, 17, 42, 128, 200}
+    bitset = np.zeros(plane.CAT_WORDS, dtype=np.uint32)
+    for b in bin_set:
+        bitset[b // 32] |= np.uint32(1 << (b % 32))
+    rscal = plane.route_scalars(layout, 2, 0, 0, miss_bin=-1, is_cat=1,
+                                cat_bitset=bitset.astype(np.int32))
+    cap = _cap_for(layout, 2048)
+    ref, nl_ref = plane.partition_ref(data, layout, 0, 2048, rscal, cap=cap)
+    got, nl_got = plane.partition_pallas(data, layout, 0, 2048, rscal,
+                                         cap=cap, interpret=True)
+    assert int(nl_ref) == int(nl_got)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    rowids = np.asarray(got[layout.rowid])[:2048]
+    in_set = np.isin(codes[rowids, 2], list(bin_set))
+    nl = int(nl_got)
+    assert in_set[:nl].all() and not in_set[nl:].any()
+
+
+def test_partition_pallas_interpret_4bit_packing():
+    """4-bit packed codes (dense_bin.hpp:17-21 IS_4BIT analogue)."""
+    layout, data, codes = _make_state(2048, 9, seed=5, code_bits=4,
+                                      max_code=16)
+    rscal = plane.route_scalars(layout, 4, 7, 0, miss_bin=15)
+    cap = _cap_for(layout, 1500)
+    ref, nl_ref = plane.partition_ref(data, layout, 300, 1500, rscal,
+                                      cap=cap)
+    got, nl_got = plane.partition_pallas(data, layout, 300, 1500, rscal,
+                                         cap=cap, interpret=True)
+    assert int(nl_ref) == int(nl_got)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_partition_pallas_interpret_stability():
+    """The partition must be STABLE (relative order preserved on both
+    sides) — the leaf-window invariants of the fused grower depend on
+    it, like the reference's ParallelPartitionRunner stable partition
+    (utils/threading.h:80)."""
+    layout, data, codes = _make_state(1024, 4, seed=3)
+    rscal = plane.route_scalars(layout, 1, 100, 0, miss_bin=249)
+    cap = _cap_for(layout, 1024)
+    got, nl = plane.partition_pallas(data, layout, 0, 1024, rscal,
+                                     cap=cap, interpret=True)
+    rowids = np.asarray(got[layout.rowid])[:1024]
+    nl = int(nl)
+    # stable: each side's rowids strictly increasing (input was iota)
+    assert (np.diff(rowids[:nl]) > 0).all()
+    assert (np.diff(rowids[nl:]) > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# histogram_radix_pallas vs histogram_scatter
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("num_bins", [16, 63, 255])
+def test_histogram_radix_pallas_interpret_matches_scatter(num_bins):
+    rng = np.random.RandomState(num_bins)
+    r, f = 1500, 11
+    bins = rng.randint(0, num_bins, size=(r, f)).astype(np.uint8)
+    grad = rng.randn(r).astype(np.float32)
+    hess = rng.rand(r).astype(np.float32)
+    want = np.asarray(histogram_scatter(jnp.asarray(bins), jnp.asarray(grad),
+                                        jnp.asarray(hess), num_bins))
+    got = np.asarray(histogram_radix_pallas(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess), num_bins,
+        rows_per_block=256, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_histogram_radix_pallas_interpret_bf16_close():
+    """bfloat16 input mode (the default tpu_hist_dtype): inputs rounded
+    to 8-bit mantissa, accumulation still f32 — totals must stay within
+    bf16 rounding of the exact answer (reference gpu_use_dp=false
+    single-precision analogue, GPU-Performance.rst accuracy tables)."""
+    rng = np.random.RandomState(0)
+    r, f, num_bins = 2000, 8, 64
+    bins = rng.randint(0, num_bins, size=(r, f)).astype(np.uint8)
+    grad = rng.randn(r).astype(np.float32)
+    hess = rng.rand(r).astype(np.float32)
+    want = np.asarray(histogram_scatter(jnp.asarray(bins), jnp.asarray(grad),
+                                        jnp.asarray(hess), num_bins))
+    got = np.asarray(histogram_radix_pallas(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess), num_bins,
+        dtype=jnp.bfloat16, rows_per_block=256, interpret=True))
+    # per-bin relative error bounded by bf16 eps times bin occupancy
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=0.3)
+    # totals (sums over bins) must agree to the same tolerance
+    np.testing.assert_allclose(got.sum(axis=1), want.sum(axis=1),
+                               rtol=1e-2, atol=0.5)
+
+
+# ---------------------------------------------------------------------------
+# histogram_planar_pallas vs histogram_scatter
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("code_bits,num_bins", [(8, 255), (8, 64), (4, 16)])
+def test_histogram_planar_pallas_interpret_matches_scatter(code_bits,
+                                                           num_bins):
+    n, g = 2048, 7
+    layout, data, codes = _make_state(n, g, seed=code_bits + num_bins,
+                                      code_bits=code_bits,
+                                      max_code=num_bins)
+    rng = np.random.RandomState(1)
+    grad = np.asarray(plane.get_f32(data, layout.grad))[:n]
+    hess = np.asarray(plane.get_f32(data, layout.hess))[:n]
+    start, count = 200, 1500
+    cap = _cap_for(layout, count)
+    got = np.asarray(histogram_planar_pallas(
+        data, start, count, num_bins=num_bins, num_cols=g,
+        code_bits=code_bits, grad_plane=layout.grad, cap=cap,
+        rows_per_block=256, interpret=True))
+    sel = slice(start, start + count)
+    want = np.asarray(histogram_scatter(
+        jnp.asarray(codes[sel]), jnp.asarray(grad[sel]),
+        jnp.asarray(hess[sel]), num_bins))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
