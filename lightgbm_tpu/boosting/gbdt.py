@@ -15,6 +15,7 @@ program over the score array. The host drives the iteration loop.
 from __future__ import annotations
 
 import math
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -112,16 +113,15 @@ class GBDT:
             self._fused = FusedSerialGrower(train_data, config, objective)
         elif config.tree_learner == "data" and len(jax.devices()) > 1:
             # fused single-dispatch iterations sharded over the device
-            # mesh (persistent path only; the host-loop parallel grower
-            # above stays as the fallback for everything else)
+            # mesh: the persistent path when eligible, the per-tree
+            # sharded path otherwise (bagging, multiclass, custom fobj)
             import copy as _copy
             cfg_serial = _copy.copy(config)
             cfg_serial.tree_learner = "serial"
             if fused_supported(cfg_serial, train_data, objective):
                 from ..treelearner.parallel import FusedDataParallelGrower
-                mc = FusedDataParallelGrower(train_data, config, objective)
-                if mc.persistent_capable:
-                    self._fused = mc
+                self._fused = FusedDataParallelGrower(
+                    train_data, config, objective)
         # persistent single-program iterations: pointwise objective, one
         # tree per iteration, no bagging/GOSS/RF/DART score surgery
         self._fused_persist = (
@@ -129,12 +129,20 @@ class GBDT:
             and self._fused._score_from_partition
             and self.num_tree_per_iteration == 1
             and config.boosting == "gbdt" and type(self) is GBDT)
-        if getattr(self._fused, "is_multichip", False) \
-                and not self._fused_persist:
-            # the sharded fused grower only implements the persistent
-            # path; everything else runs the host-loop parallel learner
-            self._fused = None
-        self._fused_check_every = 10
+        # round-4: the sharded fused grower also covers the per-tree
+        # path (bagging via per-shard local permutations, multiclass);
+        # no more persistent-only restriction
+        self._fused_check_every = 50
+        # persistent-path iteration batching: queue up to K iterations
+        # and dispatch them as ONE lax.scan program. Measured on the
+        # axon tunnel: async dispatch enqueue is already cheap, and the
+        # scan program runs ~10% SLOWER per iteration than the streamed
+        # single-dispatch program (docs/PERF_NOTES.md) — so default 1;
+        # the knob exists for high-latency dispatch environments.
+        self._iter_batch = max(1, int(os.environ.get(
+            "LGBM_TPU_ITER_BATCH", "1")))
+        self._pq_trees: list = []
+        self._pq_masks: list = []
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
 
@@ -153,12 +161,6 @@ class GBDT:
                 return SerialTreeGrower(train_data, config)
             if config.tree_learner == "serial":
                 return SerialTreeGrower(train_data, config)
-            if not train_data.efb_trivial:
-                # parallel learners shard the bin matrix by feature;
-                # decode bundles back to per-feature columns for them
-                log.warning("EFB bundles are not yet supported by parallel "
-                            "tree learners; debundling the dataset")
-                train_data.debundle()
             from ..treelearner.parallel import create_parallel_learner
             return create_parallel_learner(config.tree_learner, train_data, config)
         log.fatal("Unknown tree learner type %s", config.tree_learner)
@@ -209,19 +211,49 @@ class GBDT:
 
     def device_score_state(self):
         """The device array that per-iteration work actually updates —
-        for block_until_ready in benchmarks/profilers."""
+        for block_until_ready in benchmarks/profilers. Dispatches any
+        queued iterations first so waiting on it covers ALL requested
+        work."""
+        if self._pq_trees:
+            self._flush_persistent_queue()
         if self._fused_state is not None:
             return self._fused_state
         return self.train_score.score
 
     def get_training_score(self) -> jax.Array:
         if self._score_dirty and self._fused_state is not None:
+            self._flush_persistent_queue()
             # one scatter back to row order, only when a host consumer
             # (metrics, refit, rollback, custom fobj) actually asks
             self.train_score.score = \
                 self._fused.sync_scores(self._fused_state)[None, :]
             self._score_dirty = False
         return self.train_score.score
+
+    def _flush_persistent_queue(self) -> None:
+        """Dispatch queued persistent iterations. The full batch size
+        runs as the compiled K-iteration scan; any other size runs as
+        single-iteration dispatches (no extra compiles for partials)."""
+        q = self._pq_trees
+        if not q:
+            return
+        from ..treelearner.fused import TreeArrayBatch
+        k = len(q)
+        if k == self._iter_batch:
+            self._fused_state, ta_stack = self._fused.train_iters_persistent(
+                self._fused_state, self.shrinkage_rate,
+                jnp.stack(self._pq_masks))
+            batch = TreeArrayBatch(ta_stack)
+            for i, t in enumerate(q):
+                t.batch = batch
+                t.index = i
+        else:
+            for t, mask in zip(q, self._pq_masks):
+                self._fused_state, ta = self._fused.train_iter_persistent(
+                    self._fused_state, self.shrinkage_rate, 0.0, mask=mask)
+                t.tree_arrays = ta
+        self._pq_trees = []
+        self._pq_masks = []
 
     def _invalidate_fused_state(self) -> None:
         """Call after any direct train_score mutation (rollback, refit,
@@ -287,10 +319,7 @@ class GBDT:
                 # custom fobj supplies gradients in row order: leave the
                 # persistent state and fall through to the per-tree path
                 self._invalidate_fused_state()
-            if not getattr(self._fused, "is_multichip", False):
-                return self._train_one_iter_fused(init_scores)
-            # multichip fused grower has no per-tree path: host-loop
-            # parallel learner handles custom-gradient iterations
+            return self._train_one_iter_fused(init_scores)
 
         should_continue = False
         for c in range(k):
@@ -331,7 +360,11 @@ class GBDT:
         """Persistent fused path: the ENTIRE boosting iteration
         (gradients, tree growth, score update) is one device program
         over the leaf-permuted planar state — no [N]-sized scatter, no
-        repacking, zero synchronous host transfers."""
+        repacking, zero synchronous host transfers. Iterations are
+        QUEUED and dispatched K at a time as one lax.scan program
+        (dispatch latency amortization; see _flush_persistent_queue);
+        valid-set evaluation needs per-tree effects, so the presence of
+        valid sets keeps the batch at 1."""
         from ..treelearner.fused import PendingTree
         if self._fused_state is None:
             # created AFTER _boost_from_average, so the state's score
@@ -339,17 +372,27 @@ class GBDT:
             # (the PendingTree still gets add_bias for the model)
             self._fused_state = self._fused.init_persistent_state(
                 self.get_training_score()[0])
-        self._fused_state, ta = self._fused.train_iter_persistent(
-            self._fused_state, self.shrinkage_rate, 0.0)
+        batched = self._iter_batch > 1 and not self.valid_score
+        if batched:
+            pending = PendingTree(self._fused,
+                                  resolver=self._flush_persistent_queue)
+            self._pq_trees.append(pending)
+            self._pq_masks.append(self._fused.feature_mask_tree())
+            if len(self._pq_trees) >= self._iter_batch:
+                self._flush_persistent_queue()
+        else:
+            self._fused_state, ta = self._fused.train_iter_persistent(
+                self._fused_state, self.shrinkage_rate, 0.0)
+            pending = PendingTree(self._fused, ta)
+            if self.valid_score:
+                vals = (pending.leaf_values_device()
+                        * self.shrinkage_rate)
+                for vs in self.valid_score:
+                    vleaf = self._fused._valid_traverse_jit(
+                        ta, vs.dataset.device_bins())
+                    vs.score = vs.score.at[0].add(vals[vleaf])
         self._score_dirty = True
-        pending = PendingTree(self._fused, ta)
         pending.apply_shrinkage(self.shrinkage_rate)
-        if self.valid_score:
-            vals = pending.leaf_values_device()
-            for vs in self.valid_score:
-                vleaf = self._fused._valid_traverse_jit(
-                    ta, vs.dataset.device_bins())
-                vs.score = vs.score.at[0].add(vals[vleaf])
         if abs(init_scores[0]) > K_EPSILON:
             pending.add_bias(init_scores[0])
         self.models.append(pending)
@@ -400,6 +443,13 @@ class GBDT:
         """Leaf count without forcing a full host materialization."""
         from ..treelearner.fused import PendingTree
         if isinstance(t, PendingTree) and t._tree is None:
+            if t._ta is None and t.batch is None and t.resolver is not None:
+                t.resolver()
+            if t._ta is None and t.batch is not None \
+                    and t.batch._host is None:
+                # fetch ONE scalar, not the whole K-tree stack
+                return int(jax.device_get(
+                    t.batch.stack["n_leaves"][t.index]))
             return int(jax.device_get(t.tree_arrays["n_leaves"]))
         return t.num_leaves
 

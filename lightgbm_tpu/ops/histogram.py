@@ -31,10 +31,15 @@ Output layout: ``[F, B, 2]`` float32, channel 0 = grad, 1 = hess.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# planar-histogram block length (lanes per grid step); tunable for
+# per-step overhead experiments (see docs/PERF_NOTES.md)
+PLANAR_RB = int(os.environ.get("LGBM_TPU_HIST_RB", 1024))
 
 
 def histogram_scatter(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -433,7 +438,7 @@ def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
 def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
                             num_cols: int, code_bits: int, grad_plane: int,
                             cap: int, dtype=jnp.float32,
-                            rows_per_block: int = 512,
+                            rows_per_block: Optional[int] = None,
                             interpret: bool = False) -> jax.Array:
     """Leaf-window histogram straight off the planar state.
 
@@ -445,7 +450,7 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     from jax.experimental.pallas import tpu as pltpu
 
     P, R = data.shape
-    Rb = rows_per_block
+    Rb = rows_per_block if rows_per_block is not None else PLANAR_RB
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
     Fc = max(1, 128 // Bl)

@@ -46,7 +46,8 @@ import jax
 import jax.numpy as jnp
 
 LANE = 128          # TPU lane count; DMA offsets/sizes must align to it
-DEF_TILE = 2048
+import os as _os
+DEF_TILE = int(_os.environ.get("LGBM_TPU_TILE", 4096))
 
 
 class PlaneLayout(NamedTuple):
@@ -493,6 +494,342 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
     return dout, nleft[0, 0]
 
 
+def _partition_kernel2(scal, data_ref, dout_ref, win_ref, nleft_ref,
+                       stgL0, stgL1, stgR0, stgR1, cbufL, cbufR,
+                       semL, semR, rin0, rin1, obuf0, obuf1, lin,
+                       rsem, osem, dsem, lsem, smem, *, S, P, RB0):
+    """Two-side rewrite of `_partition_kernel` (same contract).
+
+    Side 0 makes ONE pass over the window and emits BOTH streams:
+    the L stream [pre|lefts] carry-written into scratch at window
+    coordinates (so it is already destination-aligned), and the
+    R stream [rights|tail] carry-written into a second scratch region
+    at fixed anchor `RB0 + S` (so its coordinates are independent of
+    the — still unknown — boundary). The two chunk-write chains are
+    independent and interleave, halving the per-step wait latency of
+    the v1 design, and the window is read once instead of twice.
+
+    Side 1 writes back: blocks wholly below the boundary
+    B0 = off + nleft are direct aligned HBM->HBM copies from the L
+    region; blocks at/after it are INDEPENDENT realign chunks — read an
+    aligned [S+128] slice of the R region, rotate registers by the
+    constant (S + t*S - B0) mod 128, splice the boundary block's head
+    from the L region, write an aligned [S] chunk. No carry chain on
+    this side, so the copies pipeline at bandwidth.
+
+    scal: [off, count, rs_blk, t0, t1, <ROUTE_SCALARS routing>].
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    side = pl.program_id(0)
+    t = pl.program_id(1)
+    t0 = scal[3]
+    t1 = scal[4]
+
+    @pl.when((side == 0) & (t == t0))
+    def _():
+        smem[0] = t0 * S     # L stream cursor (window coords, 128-mult)
+        smem[1] = 0          # L carry length in [0, 128)
+        smem[2] = RB0 + S    # R stream cursor (anchor RB0 + S)
+        smem[3] = 0          # R carry length
+        smem[4] = 0          # lefts seen (valid lanes only)
+        smem[5] = 0          # active stream steps taken
+
+    @pl.when((side == 0) & (t >= t0) & (t <= t1))
+    def _stream():
+        x = data_ref[...]                      # [P, S] i32
+        off = scal[0]
+        count = scal[1]
+        pos = _lane_iota(S) + t * S
+        valid = (pos >= off) & (pos < off + count)
+
+        col32 = jnp.sum(jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (P, S), 0) == scal[5], x, 0),
+            axis=0, keepdims=True)
+        rsv = [scal[5 + i] for i in range(ROUTE_SCALARS)]
+        go_left = _route_from_col32(col32, rsv)
+
+        keep_l = ((pos < off) | (valid & go_left)).astype(jnp.int32)
+        keep_r = ((valid & ~go_left) | (pos >= off + count)).astype(jnp.int32)
+        nl_here = jnp.sum((valid & go_left).astype(jnp.int32))
+        asteps = smem[5]
+        slot = jax.lax.rem(asteps, 2)
+
+        def compact(keep):
+            ranks = _lane_prefix(keep, S)
+            k = jnp.sum(keep)
+            shift = jnp.where(keep == 1, _lane_iota(S) - (ranks - 1), 0)
+            comp = x
+            sh = shift
+            b = 1
+            while b < S:
+                moved_sh = pltpu.roll(sh, S - b, 1)
+                m1 = (moved_sh & b) != 0
+                comp = jnp.where(m1, pltpu.roll(comp, S - b, 1), comp)
+                sh = jnp.where(m1, moved_sh - b, sh)
+                b *= 2
+            return comp, k
+
+        def emit(comp, k, cursor_slot, carry_slot, stg0, stg1, cbuf, sems):
+            """One stream's carry-chunk write (the v1 mechanism)."""
+            c = smem[carry_slot]
+            written = pl.multiple_of(smem[cursor_slot], 128)
+            c_inv = jax.lax.rem(128 - c, 128)
+
+            @pl.when(slot == 0)
+            def _():
+                stg0[:, :S] = comp
+                stg0[:, S:] = pltpu.roll(cbuf[...], c_inv, 1)
+                stg0[...] = pltpu.roll(stg0[...], c, 1)
+                @pl.when(asteps > 0)
+                def _():
+                    pltpu.make_async_copy(
+                        stg1, win_ref.at[:, pl.ds(0, S + 128)],
+                        sems.at[1]).wait()
+                pltpu.make_async_copy(
+                    stg0, win_ref.at[:, pl.ds(written, S + 128)],
+                    sems.at[0]).start()
+
+            @pl.when(slot == 1)
+            def _():
+                stg1[:, :S] = comp
+                stg1[:, S:] = pltpu.roll(cbuf[...], c_inv, 1)
+                stg1[...] = pltpu.roll(stg1[...], c, 1)
+                pltpu.make_async_copy(
+                    stg0, win_ref.at[:, pl.ds(0, S + 128)], sems.at[0]).wait()
+                pltpu.make_async_copy(
+                    stg1, win_ref.at[:, pl.ds(written, S + 128)],
+                    sems.at[1]).start()
+
+            total = c + k
+            adv = (total // 128) * 128
+            merged = jnp.where(slot == 0, stg0[...], stg1[...])
+            cbuf[...] = pltpu.roll(
+                merged, jax.lax.rem((S + 128) - adv, S + 128), 1)[:, :128]
+            smem[cursor_slot] = written + adv
+            smem[carry_slot] = total - adv
+
+        compL, kL = compact(keep_l)
+        emit(compL, kL, 0, 1, stgL0, stgL1, cbufL, semL)
+        compR, kR = compact(keep_r)
+        emit(compR, kR, 2, 3, stgR0, stgR1, cbufR, semR)
+
+        smem[4] = smem[4] + nl_here
+        smem[5] = asteps + 1
+
+        @pl.when(t == t1)
+        def _():
+            # drain: each chain has exactly ONE outstanding DMA (this
+            # step's) — every step waited the other slot before starting
+            @pl.when(slot == 0)
+            def _():
+                pltpu.make_async_copy(
+                    stgL0, win_ref.at[:, pl.ds(0, S + 128)], semL.at[0]).wait()
+                pltpu.make_async_copy(
+                    stgR0, win_ref.at[:, pl.ds(0, S + 128)], semR.at[0]).wait()
+            @pl.when(slot == 1)
+            def _():
+                pltpu.make_async_copy(
+                    stgL1, win_ref.at[:, pl.ds(0, S + 128)], semL.at[1]).wait()
+                pltpu.make_async_copy(
+                    stgR1, win_ref.at[:, pl.ds(0, S + 128)], semR.at[1]).wait()
+            nleft_ref[0, 0] = smem[4]
+
+    # ---- side 1: write-back ------------------------------------------
+    @pl.when((side == 1) & (t >= t0) & (t <= t1))
+    def _writeback():
+        rs_blk = scal[2]
+        B0 = scal[0] + smem[4]            # off + nleft (window coords)
+        tB = B0 // S
+        slot2 = jax.lax.rem(t, 2)
+
+        # direct copies and realign writes use SEPARATE semaphore pairs
+        # (dsem / osem) so every wait's descriptor matches its start
+        @pl.when(t < tB)
+        def _direct():
+            # L region is window-aligned: straight block copy
+            @pl.when(t > t0 + 1)
+            def _():
+                pltpu.make_async_copy(
+                    win_ref.at[:, pl.ds(0, S)],
+                    dout_ref.at[:, pl.ds(0, S)], dsem.at[slot2]).wait()
+            pltpu.make_async_copy(
+                win_ref.at[:, pl.ds(t * S, S)],
+                dout_ref.at[:, pl.ds((rs_blk + t) * S, S)],
+                dsem.at[slot2]).start()
+
+        @pl.when(t >= tB)
+        def _realign():
+            # R-region source slice for dest block t: lanes
+            # [S + t*S - B0, +S) relative to the region base; the read
+            # is 128-aligned, registers rotate by the remainder
+            src = RB0 + S + t * S - B0
+            delta = jax.lax.rem(src, 128)
+            a_t = pl.multiple_of(src - delta, 128)
+            tb_eff = jnp.maximum(tB, t0)
+
+            @pl.when(t == tb_eff)
+            def _():
+                # boundary head comes from the L region ([pre|lefts])
+                pltpu.make_async_copy(
+                    win_ref.at[:, pl.ds(t * S, S)], lin, lsem).start()
+
+            def realign_step(rin, obuf, s):
+                # t-2's READ was waited by its own step; only its WRITE
+                # (obuf -> dout) is still outstanding on this slot
+                @pl.when(t > tb_eff + 1)
+                def _():
+                    pltpu.make_async_copy(
+                        obuf, dout_ref.at[:, pl.ds(0, S)],
+                        osem.at[s]).wait()
+                pltpu.make_async_copy(
+                    win_ref.at[:, pl.ds(a_t, S + 128)], rin,
+                    rsem.at[s]).start()
+                pltpu.make_async_copy(
+                    win_ref.at[:, pl.ds(a_t, S + 128)], rin,
+                    rsem.at[s]).wait()
+                @pl.when(t == tb_eff)
+                def _():
+                    pltpu.make_async_copy(
+                        win_ref.at[:, pl.ds(t * S, S)], lin, lsem).wait()
+                rolled = pltpu.roll(
+                    rin[...], jax.lax.rem((S + 128) - delta, S + 128),
+                    1)[:, :S]
+                pos = _lane_iota(S) + t * S
+                obuf[...] = jnp.where(
+                    jnp.broadcast_to(pos < B0, (P, S)), lin[...], rolled)
+                pltpu.make_async_copy(
+                    obuf, dout_ref.at[:, pl.ds((rs_blk + t) * S, S)],
+                    osem.at[s]).start()
+
+            @pl.when(slot2 == 0)
+            def _():
+                realign_step(rin0, obuf0, 0)
+
+            @pl.when(slot2 == 1)
+            def _():
+                realign_step(rin1, obuf1, 1)
+
+        @pl.when(t == t1)
+        def _drain():
+            # outstanding writes: direct steps in [t0, min(tB, t1+1)),
+            # realign steps in [max(tB, t0), t1] — up to two per family
+            tb_eff = jnp.maximum(tB, t0)
+            td_last = jnp.minimum(tB - 1, t1)      # last direct step
+
+            def wait_direct(s):
+                pltpu.make_async_copy(
+                    win_ref.at[:, pl.ds(0, S)],
+                    dout_ref.at[:, pl.ds(0, S)], dsem.at[s]).wait()
+
+            @pl.when(td_last >= t0)
+            def _():
+                wait_direct(jax.lax.rem(td_last, 2))
+            @pl.when(td_last - 1 >= t0)
+            def _():
+                wait_direct(jax.lax.rem(td_last - 1, 2))
+
+            @pl.when(t1 >= tb_eff)
+            def _():
+                @pl.when(jax.lax.rem(t1, 2) == 0)
+                def _():
+                    pltpu.make_async_copy(
+                        obuf0, dout_ref.at[:, pl.ds(0, S)], osem.at[0]).wait()
+                @pl.when(jax.lax.rem(t1, 2) == 1)
+                def _():
+                    pltpu.make_async_copy(
+                        obuf1, dout_ref.at[:, pl.ds(0, S)], osem.at[1]).wait()
+            @pl.when(t1 - 1 >= tb_eff)
+            def _():
+                @pl.when(jax.lax.rem(t1 - 1, 2) == 0)
+                def _():
+                    pltpu.make_async_copy(
+                        obuf0, dout_ref.at[:, pl.ds(0, S)], osem.at[0]).wait()
+                @pl.when(jax.lax.rem(t1 - 1, 2) == 1)
+                def _():
+                    pltpu.make_async_copy(
+                        obuf1, dout_ref.at[:, pl.ds(0, S)], osem.at[1]).wait()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cap", "layout", "interpret"))
+def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
+                      rscal, *, cap: int, interpret: bool = False):
+    """v2 pallas stable window partition (see _partition_kernel2).
+    Same contract as partition_pallas: returns (data', nleft) with
+    data' the SAME buffer updated in place."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, R = data.shape
+    S = layout.tile
+    nt = cap // S + 1
+    wl = nt * S
+    RB0 = wl + S + 256          # R-region anchor inside the scratch
+    rs_blk = jnp.clip(jnp.asarray(start, jnp.int32) // S, 0, R // S - nt)
+    rs = rs_blk * S
+    off = jnp.asarray(start, jnp.int32) - rs
+    count = jnp.asarray(count, jnp.int32)
+    t0 = off // S
+    t1 = jnp.maximum(off + count - 1, 0) // S
+    kern_scal = jnp.concatenate([
+        jnp.stack([off, count, rs_blk, t0, t1]),
+        rscal.astype(jnp.int32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(2, nt),
+        in_specs=[pl.BlockSpec(
+            (P, S),
+            # side 1 never reads data_ref: pin its index to block t0 so
+            # the pipeline does not refetch the whole window a second
+            # time (repeated index -> no refetch)
+            lambda side, t, scal: (0, scal[2] + jnp.where(
+                side == 0, jnp.clip(t, scal[3], scal[4]), scal[3])))],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((P, S + 128), jnp.int32),   # stgL0
+            pltpu.VMEM((P, S + 128), jnp.int32),   # stgL1
+            pltpu.VMEM((P, S + 128), jnp.int32),   # stgR0
+            pltpu.VMEM((P, S + 128), jnp.int32),   # stgR1
+            pltpu.VMEM((P, 128), jnp.int32),       # cbufL
+            pltpu.VMEM((P, 128), jnp.int32),       # cbufR
+            pltpu.SemaphoreType.DMA((2,)),         # semL
+            pltpu.SemaphoreType.DMA((2,)),         # semR
+            pltpu.VMEM((P, S + 128), jnp.int32),   # rin0
+            pltpu.VMEM((P, S + 128), jnp.int32),   # rin1
+            pltpu.VMEM((P, S), jnp.int32),         # obuf0
+            pltpu.VMEM((P, S), jnp.int32),         # obuf1
+            pltpu.VMEM((P, S), jnp.int32),         # lin
+            pltpu.SemaphoreType.DMA((2,)),         # rsem
+            pltpu.SemaphoreType.DMA((2,)),         # osem
+            pltpu.SemaphoreType.DMA((2,)),         # dsem
+            pltpu.SemaphoreType.DMA,               # lsem
+            pltpu.SMEM((6,), jnp.int32),           # smem
+        ],
+    )
+    dout, _win, nleft = pl.pallas_call(
+        functools.partial(_partition_kernel2, S=S, P=P, RB0=RB0),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((P, R), jnp.int32),
+            # L region [0, RB0) holds <= wl + S + 128 written lanes;
+            # R region cursor starts at RB0 + S and streams up to wl
+            # lanes in (S+128)-wide chunks -> needs wl + 2S + 256
+            jax.ShapeDtypeStruct((P, RB0 + wl + 2 * S + 256), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(kern_scal, data)
+    return dout, nleft[0, 0]
+
+
 def partition_window(data, layout, start, count, rscal, *, cap,
                      method="auto", interpret=False):
     if method == "auto":
@@ -500,6 +837,9 @@ def partition_window(data, layout, start, count, rscal, *, cap,
     if method == "pallas":
         return partition_pallas(data, layout, start, count, rscal,
                                 cap=cap, interpret=interpret)
+    if method == "pallas2":
+        return partition_pallas2(data, layout, start, count, rscal,
+                                 cap=cap, interpret=interpret)
     return partition_ref(data, layout, start, count, rscal, cap=cap)
 
 

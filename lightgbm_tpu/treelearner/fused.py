@@ -41,6 +41,7 @@ back to the host-loop grower (treelearner/serial.py).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, NamedTuple, Optional
 
 import numpy as np
@@ -188,9 +189,10 @@ class FusedSerialGrower:
         self.group_max_bin = dataset.group_max_bins
         # backend dispatch: ops/histogram.hist_method is the ONE shared
         # precision choice for every learner; partition follows suit
+        # (LGBM_TPU_PART selects the carry-stream kernel generation)
         self._hist_method = H.hist_method(config)
-        self._part_method = ("pallas" if self._hist_method is not None
-                             else "ref")
+        self._part_method = (os.environ.get("LGBM_TPU_PART", "pallas2")
+                             if self._hist_method is not None else "ref")
 
         # planar layout: label/score/weight planes only when the
         # objective can run the persistent in-program loop. Codes pack
@@ -249,16 +251,21 @@ class FusedSerialGrower:
         self.psum_axis = None
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         # capacity ladder for the lax.switch partition/histogram
-        # branches, in lane-tile units. Factor 4 keeps the program small
-        # (each branch duplicates its kernels); the carry-stream kernel
-        # cost scales with the window so padding costs bandwidth only.
+        # branches, in lane-tile units. Every switch branch duplicates
+        # its kernels in the while-body HLO, so the ladder factor trades
+        # XLA compile time against window padding; padded blocks outside
+        # the leaf range are SKIPPED by both kernels (index pinned, no
+        # compute/DMA), so a coarse ladder costs only skipped-step
+        # iteration overhead, not bandwidth.
+        factor = int(np.clip(
+            int(os.environ.get("LGBM_TPU_LADDER", 4)), 2, 64))
         tile = self.layout.tile
         top = self.layout.num_lanes - tile
         self._caps = []
         c = tile * 4
         while c < top:
             self._caps.append(c)
-            c *= 4
+            c *= factor
         self._caps.append(top)
         self._grow_jit = jax.jit(self._grow_tree,
                                  static_argnames=("compute_score_update",))
@@ -801,9 +808,37 @@ class FusedSerialGrower:
         data = plane.set_f32(st.data, Ly.score, score2)
         return data, ta
 
-    def train_iter_persistent(self, data, shrinkage, bias):
-        return self._iter_jit(data, self.feature_mask_tree(),
-                              jnp.float32(shrinkage), jnp.float32(bias))
+    def train_iter_persistent(self, data, shrinkage, bias, mask=None):
+        if mask is None:
+            mask = self.feature_mask_tree()
+        return self._iter_jit(data, mask, jnp.float32(shrinkage),
+                              jnp.float32(bias))
+
+    def _iters_scan_jit_build(self, k: int):
+        """K boosting iterations in ONE dispatch: lax.scan over the
+        persistent iteration body (traced once, so compile cost matches
+        the single-iteration program). Exists because each dispatch over
+        the remote-accelerator tunnel costs tens of ms of host latency —
+        at K=10 the per-iteration dispatch overhead drops 10x."""
+        def run(data, masks, shrinkage):
+            def step(d, mask):
+                d, ta = self._train_iter(d, mask, shrinkage,
+                                         jnp.float32(0.0))
+                return d, ta
+            return jax.lax.scan(step, data, masks, length=k)
+
+        return jax.jit(run, donate_argnums=0)
+
+    def train_iters_persistent(self, data, shrinkage, masks):
+        """masks: [K, F] stacked per-tree feature masks. Returns
+        (data, ta_stacked) where every array in ta_stacked has a leading
+        [K] axis (iteration k's tree = slice k)."""
+        k = int(masks.shape[0])
+        if getattr(self, "_iters_jit_k", None) is None:
+            self._iters_jit_k = {}
+        if k not in self._iters_jit_k:
+            self._iters_jit_k[k] = self._iters_scan_jit_build(k)
+        return self._iters_jit_k[k](data, masks, jnp.float32(shrinkage))
 
     def _sync_scores(self, data):
         n = self.layout.num_rows
@@ -870,13 +905,18 @@ class FusedSerialGrower:
     # ------------------------------------------------------------------
     def feature_mask_tree(self) -> jax.Array:
         f = self.num_features
-        mask = np.ones(f, dtype=bool)
         frac = self.config.feature_fraction
-        if frac < 1.0:
-            k = max(1, int(np.ceil(frac * f)))
-            chosen = self._col_rng.choice(f, size=k, replace=False)
-            mask[:] = False
-            mask[chosen] = True
+        if frac >= 1.0:
+            # constant all-ones mask: upload ONCE. A fresh jnp.asarray
+            # per iteration is a host->device transfer on the dispatch
+            # path of every tree (~100 ms tunnel latency class)
+            if getattr(self, "_mask_ones_dev", None) is None:
+                self._mask_ones_dev = jnp.ones(f, dtype=bool)
+            return self._mask_ones_dev
+        mask = np.zeros(f, dtype=bool)
+        k = max(1, int(np.ceil(frac * f)))
+        chosen = self._col_rng.choice(f, size=k, replace=False)
+        mask[chosen] = True
         return jnp.asarray(mask)
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -947,20 +987,59 @@ class FusedSerialGrower:
         return tree
 
 
+class TreeArrayBatch:
+    """Stacked tree arrays of K scan-batched iterations (leading [K]
+    axis on every array): one device→host fetch serves all K trees."""
+
+    def __init__(self, stack: Dict) -> None:
+        self.stack = stack
+        self._host: Optional[Dict] = None
+
+    def host(self) -> Dict:
+        if self._host is None:
+            self._host = jax.device_get(self.stack)
+        return self._host
+
+
 class PendingTree:
     """Lazily-materialized device tree: keeps the raw device arrays until
     a host consumer needs a real Tree, so the training loop never blocks
     on a device→host fetch. Any Tree attribute access (num_leaves,
     to_string, leaf_index_raw, ...) transparently materializes the host
     Tree once and delegates to it, so consumers that read GBDT.models
-    directly keep working without an explicit materialize pass."""
+    directly keep working without an explicit materialize pass.
 
-    def __init__(self, grower: FusedSerialGrower, tree_arrays: Dict) -> None:
+    Three sourcing modes for the arrays: direct (``tree_arrays`` given),
+    batched (``batch``+``index`` into a TreeArrayBatch), or queued
+    (``resolver`` — a callable that dispatches the owning driver's
+    queued iterations and then assigns ``batch``/``tree_arrays``)."""
+
+    def __init__(self, grower: FusedSerialGrower,
+                 tree_arrays: Optional[Dict] = None, *,
+                 batch: Optional[TreeArrayBatch] = None,
+                 index: int = 0, resolver=None) -> None:
         self._tree: Optional[Tree] = None
         self.grower = grower
-        self.tree_arrays = tree_arrays
+        self._ta = tree_arrays
+        self.batch = batch
+        self.index = index
+        self.resolver = resolver
         self.pending_shrinkage = 1.0
         self.pending_bias = 0.0
+
+    @property
+    def tree_arrays(self) -> Dict:
+        if self._ta is None:
+            if self.batch is None and self.resolver is not None:
+                self.resolver()           # dispatch queued iterations
+            if self._ta is None:
+                h = self.batch.host()
+                self._ta = {k: v[self.index] for k, v in h.items()}
+        return self._ta
+
+    @tree_arrays.setter
+    def tree_arrays(self, value: Dict) -> None:
+        self._ta = value
 
     def apply_shrinkage(self, rate: float) -> None:
         if self._tree is not None:
@@ -995,7 +1074,8 @@ class PendingTree:
         # materialize once and delegate. Guard against recursion during
         # unpickling/copy before __init__ has run.
         if name.startswith("__") or name in ("_tree", "grower", "tree_arrays",
-                                             "pending_shrinkage",
+                                             "_ta", "batch", "index",
+                                             "resolver", "pending_shrinkage",
                                              "pending_bias"):
             raise AttributeError(name)
         return getattr(self.materialize(), name)

@@ -48,6 +48,26 @@ from .serial import SerialTreeGrower, _Leaf
 from .fused import FusedSerialGrower, fused_supported
 
 
+def shard_bag_permutation(perm, bag_cnt: int, num_shards: int,
+                          rows_per_shard: int):
+    """Global bag permutation -> per-shard LOCAL permutations (bag rows
+    first, in order) + per-shard bag counts — the reference's
+    SetBaggingData semantics applied to each machine's own row shard.
+    Shard d owns global rows [d*rows_per_shard, (d+1)*rows_per_shard)."""
+    D, sr = num_shards, rows_per_shard
+    mask = np.zeros(D * sr, dtype=bool)
+    mask[np.asarray(perm[:bag_cnt])] = True
+    perm_np = np.empty((D, sr), np.int32)
+    counts = np.empty(D, np.int32)
+    m2 = mask.reshape(D, sr)
+    for d in range(D):
+        bag_local = np.flatnonzero(m2[d]).astype(np.int32)
+        oob_local = np.flatnonzero(~m2[d]).astype(np.int32)
+        perm_np[d] = np.concatenate([bag_local, oob_local])
+        counts[d] = len(bag_local)
+    return perm_np, counts
+
+
 def build_mesh(config: Config) -> Mesh:
     """Mesh from tpu_mesh_shape (defaults to all devices on one axis)."""
     devices = np.asarray(jax.devices())
@@ -100,6 +120,8 @@ class DataParallelTreeGrower(SerialTreeGrower):
     @functools.lru_cache(maxsize=64)
     def _hist_fn_sharded(self, capacity: int):
         B = self.max_num_bin
+        Bg = self.group_max_bin
+        efb_hist = self._efb_hist
         mesh = self.mesh
         method = H.hist_method(self.config)
 
@@ -112,7 +134,8 @@ class DataParallelTreeGrower(SerialTreeGrower):
         def fn(bins, perm, start, count, grad, hess):
             # leading length-1 shard axis inside the body
             h = H.leaf_histogram(bins[0], perm[0], start[0], count[0],
-                                 grad[0], hess[0], capacity, B,
+                                 grad[0], hess[0], capacity,
+                                 Bg if efb_hist is not None else B,
                                  method=method)
             # ReduceScatter+Allgather of the reference (:169) collapses
             # to one ICI all-reduce; feature-sharded scan is a later
@@ -122,12 +145,22 @@ class DataParallelTreeGrower(SerialTreeGrower):
             # from an Allreduce of (count, Σg, Σh) tuples, :126-152)
             sg = jax.lax.psum(jnp.sum(h[0, :, 0]), "data")
             sh = jax.lax.psum(jnp.sum(h[0, :, 1]), "data")
+            if efb_hist is not None:
+                # EFB bundles stay sharded (round-4: no more debundling
+                # under parallel learners): the bundle-space histogram
+                # is psum'd, then gathered to per-feature space with the
+                # mfb FixHistogram reconstruction — which needs GLOBAL
+                # totals, hence after the psum
+                from ..io.efb import per_feature_hist
+                total = hist[0].sum(axis=0)
+                hist = per_feature_hist(hist, efb_hist, total[0], total[1])
             return hist, sg, sh
         return fn
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn_sharded(self, capacity: int):
         mesh = self.mesh
+        efb = self._efb_dev
 
         @jax.jit
         @functools.partial(
@@ -140,7 +173,8 @@ class DataParallelTreeGrower(SerialTreeGrower):
             from ..ops.partition import partition_leaf
             new_perm, lc = partition_leaf(
                 bins[0], perm[0], start[0], count[0], feature, threshold,
-                default_left, miss_bin, is_cat, cat_bitset, capacity)
+                default_left, miss_bin, is_cat, cat_bitset, capacity,
+                efb=efb)
             return new_perm[None], lc[None]
         return fn
 
@@ -171,12 +205,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
             mask[np.asarray(perm[:num_data])] = True
             grad_np = np.where(mask, grad_np, 0.0)
             hess_np = np.where(mask, hess_np, 0.0)
-            mask2 = mask.reshape(d, rps)
-            for s in range(d):
-                bag_local = np.flatnonzero(mask2[s]).astype(np.int32)
-                oob_local = np.flatnonzero(~mask2[s]).astype(np.int32)
-                perm_np[s] = np.concatenate([bag_local, oob_local])
-                counts0[s] = len(bag_local)
+            perm_np, counts0 = shard_bag_permutation(perm, num_data, d, rps)
         g_sh = jax.device_put(jnp.asarray(grad_np.reshape(d, rps)), self._spec_rows)
         h_sh = jax.device_put(jnp.asarray(hess_np.reshape(d, rps)), self._spec_rows)
         perm_sh = jax.device_put(jnp.asarray(perm_np), self._spec_rows)
@@ -336,6 +365,8 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
     @functools.lru_cache(maxsize=64)
     def _hist_fn_sharded(self, capacity: int):
         B = self.max_num_bin
+        Bg = self.group_max_bin
+        efb_hist = self._efb_hist
         mesh = self.mesh
         top_k = self.config.top_k
         meta = self.meta
@@ -350,8 +381,17 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             out_specs=P())
         def fn(bins, perm, start, count, grad, hess):
             h = H.leaf_histogram(bins[0], perm[0], start[0], count[0],
-                                 grad[0], hess[0], capacity, B,
+                                 grad[0], hess[0], capacity,
+                                 Bg if efb_hist is not None else B,
                                  method=method)
+            if efb_hist is not None:
+                # voting scans LOCAL per-feature histograms; the mfb
+                # reconstruction is linear in the group histogram, so
+                # reconstructing per shard and psum'ing selected
+                # features afterwards equals the global reconstruction
+                from ..io.efb import per_feature_hist
+                tot = h[0].sum(axis=0)
+                h = per_feature_hist(h, efb_hist, tot[0], tot[1])
             # local scan for voting (min_data divided by #machines,
             # reference :62-64)
             local_cfg = S.SplitConfig(
@@ -450,6 +490,7 @@ class FusedDataParallelGrower(FusedSerialGrower):
             jnp.asarray(counts, jnp.int32),
             NamedSharding(self.mesh, P("data")))
         self._iter_mc_jit = None
+        self._grow_mc_tree_jit = None
 
     # -- sharded state construction ------------------------------------
     def _shard_lane_pad(self, v, fill=0.0, dtype=jnp.float32):
@@ -493,19 +534,41 @@ class FusedDataParallelGrower(FusedSerialGrower):
             data, NamedSharding(self.mesh, P(None, "data")))
 
     # -- sharded iteration ---------------------------------------------
-    def train_iter_persistent(self, data, shrinkage, bias):
+    def train_iter_persistent(self, data, shrinkage, bias, mask=None):
+        if mask is None:
+            mask = self.feature_mask_tree()
         if self._iter_mc_jit is None:
-            def body(data_l, nvalid_l, mask, shr, b):
-                return self._train_iter(data_l, mask, shr, b,
+            def body(data_l, nvalid_l, mask_, shr, b):
+                return self._train_iter(data_l, mask_, shr, b,
                                         n_valid=nvalid_l[0])
             f = functools.partial(
                 shard_map, mesh=self.mesh, check_vma=False,
                 in_specs=(P(None, "data"), P("data"), P(), P(), P()),
                 out_specs=(P(None, "data"), P()))(body)
             self._iter_mc_jit = jax.jit(f, donate_argnums=0)
-        return self._iter_mc_jit(data, self._n_per_shard,
-                                 self.feature_mask_tree(),
+        return self._iter_mc_jit(data, self._n_per_shard, mask,
                                  jnp.float32(shrinkage), jnp.float32(bias))
+
+    def train_iters_persistent(self, data, shrinkage, masks):
+        """K sharded iterations in one dispatch (scan inside shard_map);
+        see FusedSerialGrower.train_iters_persistent."""
+        k = int(masks.shape[0])
+        if getattr(self, "_iters_mc_jit_k", None) is None:
+            self._iters_mc_jit_k = {}
+        if k not in self._iters_mc_jit_k:
+            def body(data_l, nvalid_l, masks_, shr):
+                def step(d, mask):
+                    d, ta = self._train_iter(d, mask, shr, jnp.float32(0.0),
+                                             n_valid=nvalid_l[0])
+                    return d, ta
+                return jax.lax.scan(step, data_l, masks_, length=k)
+            f = functools.partial(
+                shard_map, mesh=self.mesh, check_vma=False,
+                in_specs=(P(None, "data"), P("data"), P(), P()),
+                out_specs=(P(None, "data"), P()))(body)
+            self._iters_mc_jit_k[k] = jax.jit(f, donate_argnums=0)
+        return self._iters_mc_jit_k[k](data, self._n_per_shard, masks,
+                                       jnp.float32(shrinkage))
 
     def _sync_scores(self, data):
         from ..ops import plane
@@ -522,6 +585,95 @@ class FusedDataParallelGrower(FusedSerialGrower):
         return functools.partial(
             shard_map, mesh=self.mesh, check_vma=False,
             in_specs=(P(None, "data"),), out_specs=P())(body)(data)
+
+    # -- sharded per-tree path (bagging / multiclass / custom fobj) -----
+    def _bins_row_sharded(self):
+        """[D, sr, F] row-contiguous bin shards (same ownership as the
+        persistent state: shard d owns rows [d*sr, (d+1)*sr))."""
+        if getattr(self, "_bins_sh", None) is None:
+            D, sr = self.num_shards, self.shard_rows
+            bins_np = np.asarray(self.bins)
+            pad = D * sr - bins_np.shape[0]
+            if pad:
+                bins_np = np.pad(bins_np, ((0, pad), (0, 0)), mode="edge")
+            self._bins_sh = jax.device_put(
+                jnp.asarray(bins_np.reshape(D, sr, -1)),
+                NamedSharding(self.mesh, P("data", None, None)))
+        return self._bins_sh
+
+    def _sharded_bag_views(self, perm, bag_cnt):
+        """Device-resident (per-shard local perms, per-shard counts) for
+        a bag. Cached on the perm object so the k class trees of one
+        iteration (and consecutive no-bagging iterations) skip the O(n)
+        host pass and the [n]-sized upload entirely."""
+        key = (id(perm), int(bag_cnt))
+        if getattr(self, "_bag_cache_key", None) == key:
+            return self._bag_cache_val
+        D, sr, n = self.num_shards, self.shard_rows, self.global_rows
+        spec_rows = NamedSharding(self.mesh, P("data", None))
+        if bag_cnt >= n:
+            # no bagging: identity local perms, true per-shard row counts
+            perm_np = np.broadcast_to(
+                np.arange(sr, dtype=np.int32)[None], (D, sr))
+            counts = np.asarray(
+                [max(0, min(n - d * sr, sr)) for d in range(D)], np.int32)
+        else:
+            perm_np, counts = shard_bag_permutation(perm, bag_cnt, D, sr)
+        val = (jax.device_put(jnp.asarray(perm_np), spec_rows),
+               jax.device_put(jnp.asarray(counts),
+                              NamedSharding(self.mesh, P("data"))))
+        self._bag_cache_key = key
+        self._bag_cache_ref = perm      # keep id() stable
+        self._bag_cache_val = val
+        return val
+
+    def _grow_mc_jit_build(self):
+        from ..ops import plane
+        Ly = self.layout
+
+        def body(bins_l, perm_l, cnt_l, g_l, h_l, mask):
+            bins_l, perm_l, cnt_l = bins_l[0], perm_l[0], cnt_l[0]
+            g_l, h_l = g_l[0], h_l[0]
+            # one row gather per TREE (not per split) builds the
+            # bag-ordered planar pack, as on the single-chip path
+            cp = plane.build_codes_planes(bins_l[perm_l], Ly)
+            data = plane.build_data(Ly, cp, g_l[perm_l], h_l[perm_l],
+                                    rowid=perm_l)
+            ta, _st = self._grow_tree_core(data, cnt_l, mask)
+            # leaf of EVERY local row (incl. out-of-bag) for the score
+            # update, via bin-space traversal of the fresh tree
+            leaf = self.traverse_bins(ta, bins_l)
+            return ta, leaf[None]
+
+        f = functools.partial(
+            shard_map, mesh=self.mesh, check_vma=False,
+            in_specs=(P("data", None, None), P("data", None), P("data"),
+                      P("data", None), P("data", None), P()),
+            out_specs=(P(), P("data", None)))(body)
+        return jax.jit(f)
+
+    def grow_device(self, grad, hess, perm, bag_cnt,
+                    compute_score_update=True):
+        """Sharded per-tree growth (reference
+        data_parallel_tree_learner.cpp covers every config through one
+        network layer; here every config runs the same while_loop
+        program per shard with psum'd histograms)."""
+        D, sr, n = self.num_shards, self.shard_rows, self.global_rows
+        perm_dev, counts_dev = self._sharded_bag_views(perm, bag_cnt)
+        spec_rows = NamedSharding(self.mesh, P("data", None))
+
+        def pad_rows(v):
+            v = jnp.asarray(v, jnp.float32)
+            v = jnp.pad(v, (0, D * sr - v.shape[0]))
+            return jax.device_put(v.reshape(D, sr), spec_rows)
+
+        if self._grow_mc_tree_jit is None:
+            self._grow_mc_tree_jit = self._grow_mc_jit_build()
+        ta, leaf = self._grow_mc_tree_jit(
+            self._bins_row_sharded(), perm_dev, counts_dev,
+            pad_rows(grad), pad_rows(hess), self.feature_mask_tree())
+        leaf_of_row = leaf.reshape(-1)[:n] if compute_score_update else None
+        return ta, leaf_of_row
 
 
 

@@ -44,20 +44,25 @@ def _cap_for(layout, count):
     return min(cap, layout.num_lanes - tile)
 
 
+@pytest.mark.parametrize("kernel", [plane.partition_pallas,
+                                    plane.partition_pallas2])
 @pytest.mark.parametrize("start,count,feat,thr,dl", [
     (0, 4096, 3, 120, 0),        # full window
     (1234, 2000, 7, 60, 1),      # interior window, default-left
     (4000, 96, 0, 200, 0),       # tail window
     (17, 3, 5, 10, 1),           # tiny leaf
+    (100, 3900, 3, 5, 0),        # nearly all right (boundary near off)
+    (100, 3900, 3, 245, 0),      # nearly all left (boundary near end)
 ])
-def test_partition_pallas_interpret_matches_ref(start, count, feat, thr, dl):
+def test_partition_pallas_interpret_matches_ref(kernel, start, count, feat,
+                                                thr, dl):
     layout, data, codes = _make_state(4096, 12, seed=start + count)
     rscal = plane.route_scalars(layout, feat, thr, dl, miss_bin=249)
     cap = _cap_for(layout, count)
     ref, nl_ref = plane.partition_ref(data, layout, start, count, rscal,
                                       cap=cap)
-    got, nl_got = plane.partition_pallas(data, layout, start, count, rscal,
-                                         cap=cap, interpret=True)
+    got, nl_got = kernel(data, layout, start, count, rscal,
+                         cap=cap, interpret=True)
     assert int(nl_ref) == int(nl_got)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
     # independent semantic check against the raw codes: rows in
@@ -104,7 +109,9 @@ def test_partition_pallas_interpret_4bit_packing():
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
-def test_partition_pallas_interpret_stability():
+@pytest.mark.parametrize("kernel", [plane.partition_pallas,
+                                    plane.partition_pallas2])
+def test_partition_pallas_interpret_stability(kernel):
     """The partition must be STABLE (relative order preserved on both
     sides) — the leaf-window invariants of the fused grower depend on
     it, like the reference's ParallelPartitionRunner stable partition
@@ -112,8 +119,7 @@ def test_partition_pallas_interpret_stability():
     layout, data, codes = _make_state(1024, 4, seed=3)
     rscal = plane.route_scalars(layout, 1, 100, 0, miss_bin=249)
     cap = _cap_for(layout, 1024)
-    got, nl = plane.partition_pallas(data, layout, 0, 1024, rscal,
-                                     cap=cap, interpret=True)
+    got, nl = kernel(data, layout, 0, 1024, rscal, cap=cap, interpret=True)
     rowids = np.asarray(got[layout.rowid])[:1024]
     nl = int(nl)
     # stable: each side's rowids strictly increasing (input was iota)

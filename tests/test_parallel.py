@@ -74,7 +74,7 @@ def test_data_parallel_close_to_serial(eight_devices):
     assert b_serial._gbdt._fused is None
     params_dp = {"objective": "binary", "verbose": -1,
                  "tree_learner": "data", "num_machines": 8,
-                 "min_data_in_leaf": 20, **bag}
+                 "min_data_in_leaf": 20, "tpu_fused": False, **bag}
     b_dp = lgb.train(params_dp, lgb.Dataset(X, label=y), num_boost_round=5,
                      verbose_eval=False)
     from lightgbm_tpu.treelearner.parallel import DataParallelTreeGrower
