@@ -90,8 +90,19 @@ def emit(partial: bool) -> None:
         out["partial"] = True
     if STATE["test_auc"] is not None:
         out["test_auc"] = round(STATE["test_auc"], 5)
+        # held-out AUC on a task with Bayes ceiling ~0.875 (see
+        # make_higgs_like) — comparable in difficulty to real HIGGS,
+        # where the reference reaches 0.845724 (Experiments.rst:134)
+        out["test_auc_bayes_ceiling"] = 0.875
     if STATE["example_auc"] is not None:
         out["example_auc"] = round(STATE["example_auc"], 5)
+        # real data: reference examples/binary_classification trained at
+        # its own train.conf (100 trees, 63 leaves, ff 0.8, bagging
+        # 0.8/5, min_data 50, min_hess 5.0), scored on binary.test.
+        # No compiled reference binary exists in this environment to
+        # produce a measured comparator; the config provenance makes the
+        # number auditable against any LightGBM 3.x build
+        out["example_conf"] = "reference train.conf, 7000 train/500 test"
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -104,12 +115,24 @@ def _on_signal(signum, frame):
     os._exit(0)
 
 
-def make_higgs_like(n, f, seed=0):
+def make_higgs_like(n, f, seed=0, scale=2.4):
+    """Synthetic stand-in calibrated to real HIGGS difficulty.
+
+    Labels are DRAWN from p = sigmoid(s(x)) with s standardized to
+    `scale`, giving a Bayes-optimal AUC of ~0.875 (measured on 400k
+    samples) — so held-out AUC is discriminative the way real HIGGS is
+    (reference reports 0.845724 after 500 iters, Experiments.rst:134;
+    our model reaches ~0.857 at 300 iters/1M rows). The round-3
+    generator saturated at AUC 0.98, where a broken split search could
+    hide; on this one it visibly loses."""
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f).astype(np.float32)
-    w = rng.randn(f) * (rng.rand(f) > 0.3)
-    logit = X @ w * 0.5 + 0.7 * np.sin(X[:, 0] * 2) * X[:, 1]
-    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    s = (0.9 * X[:, 0] - 0.8 * X[:, 1] + 1.1 * X[:, 2] * X[:, 3]
+         + 0.8 * np.sin(2 * X[:, 4]) * X[:, 5] + 0.6 * (X[:, 6] ** 2 - 1)
+         + 0.7 * X[:, 7] * X[:, 8] * X[:, 9]
+         + 0.5 * np.tanh(X[:, 10]) * X[:, 11])
+    s = (s - s.mean()) / s.std() * scale
+    y = (rng.rand(n) < 1.0 / (1.0 + np.exp(-s))).astype(np.float32)
     return X, y
 
 
@@ -231,7 +254,7 @@ def main():
         STATE["test_auc"] = _auc(yte, bst.predict(Xte))
     except Exception as exc:
         print(f"# test AUC failed: {exc}", file=sys.stderr)
-    if STATE["test_auc"] is not None and STATE["test_auc"] < 0.70:
+    if STATE["test_auc"] is not None and STATE["test_auc"] < 0.80:
         print("# WARNING: held-out AUC sanity check failed — the speed "
               "number is from a broken model", file=sys.stderr)
 

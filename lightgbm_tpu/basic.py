@@ -384,11 +384,18 @@ class Booster:
     # ------------------------------------------------------------------
     def set_network(self, machines, local_listen_port: int = 12400,
                     listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
-        """On TPU the "network" is the ICI/DCN mesh; this keeps API
-        compatibility (reference basic.py:2093 / LGBM_NetworkInit) but
-        mesh configuration comes from tpu_mesh_shape / jax.distributed."""
-        log.warning("set_network is a no-op in lightgbm_tpu: collectives "
-                    "run over the JAX device mesh")
+        """Multi-host wiring (reference basic.py:2093 set_network ->
+        LGBM_NetworkInit, c_api.cpp:2262). On TPU the collective STACK
+        is XLA's (psum/all_gather over ICI/DCN); what this call does is
+        the process wiring: `jax.distributed.initialize` with the rank
+        discovered from the machine list, fusing every host's chips
+        into the one global device set (lightgbm_tpu.network; launch
+        recipe in docs/MULTIHOST.md)."""
+        from .network import ensure_distributed
+        if isinstance(machines, (list, set)):
+            machines = ",".join(str(m) for m in machines)
+        ensure_distributed(machines, num_machines,
+                           time_out=listen_time_out)
         self._network_initialized = True
         return self
 

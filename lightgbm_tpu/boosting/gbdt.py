@@ -105,11 +105,13 @@ class GBDT:
         self.tree_learner = self._create_tree_learner(config, train_data)
         # fused single-dispatch path (treelearner/fused.py): mandatory for
         # remote-accelerator latency; host-loop grower covers the rest
-        from ..treelearner.fused import FusedSerialGrower, fused_supported
+        from ..treelearner.fused import (FusedSerialGrower,
+                                         fused_reject_reason)
         self._fused = None
         self._fused_state = None     # persistent planar state (device)
         self._score_dirty = False    # train_score stale vs _fused_state
-        if fused_supported(config, train_data, objective):
+        reason = fused_reject_reason(config, train_data, objective)
+        if reason is None:
             self._fused = FusedSerialGrower(train_data, config, objective)
         elif config.tree_learner == "data" and len(jax.devices()) > 1:
             # fused single-dispatch iterations sharded over the device
@@ -118,10 +120,22 @@ class GBDT:
             import copy as _copy
             cfg_serial = _copy.copy(config)
             cfg_serial.tree_learner = "serial"
-            if fused_supported(cfg_serial, train_data, objective):
+            reason = fused_reject_reason(cfg_serial, train_data, objective)
+            if reason is None:
                 from ..treelearner.parallel import FusedDataParallelGrower
                 self._fused = FusedDataParallelGrower(
                     train_data, config, objective)
+        if self._fused is None and jax.default_backend() == "tpu" \
+                and reason not in (None, "tpu_fused=false") \
+                and config.tree_learner in ("serial", "data"):
+            # name the responsible option: on a remote accelerator the
+            # host-loop grower dispatches >= 2 kernels per SPLIT (~10x
+            # slower per iteration than the fused while_loop program)
+            log.warning(
+                "Config option [%s] is not supported by the fused "
+                "single-dispatch tree grower; falling back to the "
+                "host-loop grower (~10x slower per iteration on TPU)",
+                reason)
         # persistent single-program iterations: pointwise objective, one
         # tree per iteration, no bagging/GOSS/RF/DART score surgery
         self._fused_persist = (
@@ -377,7 +391,7 @@ class GBDT:
             pending = PendingTree(self._fused,
                                   resolver=self._flush_persistent_queue)
             self._pq_trees.append(pending)
-            self._pq_masks.append(self._fused.feature_mask_tree())
+            self._pq_masks.append(self._fused.feature_masks_for_tree())
             if len(self._pq_trees) >= self._iter_batch:
                 self._flush_persistent_queue()
         else:

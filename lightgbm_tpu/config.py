@@ -483,6 +483,13 @@ class Config:
 
     # ------------------------------------------------------------------
     @classmethod
+    def resolve_alias(cls, name: str) -> str:
+        """Canonical parameter name for an alias (identity when not an
+        alias) — the one ParameterAlias::KeyAliasTransform lookup."""
+        name = str(name).strip()
+        return _ALIASES.get(name, name)
+
+    @classmethod
     def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
         """Build a Config from a user params dict, resolving aliases.
 
@@ -537,6 +544,12 @@ class Config:
                 setattr(self, name, _parse_float_list(value))
         elif name in ("categorical_feature", "interaction_constraints"):
             setattr(self, name, value)
+        elif name == "machines":
+            # the reference python package accepts machine LISTS and
+            # joins them with "," (basic.py set_network plumbing)
+            if isinstance(value, (list, tuple, set)):
+                value = ",".join(str(m) for m in value)
+            setattr(self, name, str(value))
         elif tp == "bool" or isinstance(getattr(self, name), bool):
             setattr(self, name, _parse_bool(value))
         elif isinstance(getattr(self, name), int):

@@ -16,7 +16,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset, LightGBMError
-from .config import _ALIASES
+from .config import Config, _ALIASES
 from .utils import log
 
 
@@ -67,6 +67,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
     """reference engine.py:18."""
     params = copy.deepcopy(params) if params else {}
     _ensure_jit_cache()
+    # multi-host process wiring BEFORE any dataset construction, so the
+    # distributed bin-mapper allgather and the training mesh see the
+    # global device set (reference Application::InitTrain calls
+    # Network::Init first, application.cpp:164-175). Alias resolution
+    # goes through Config so "workers"/"nodes"/"num_machine" work here
+    # exactly as everywhere else.
+    net_cfg = Config.from_params({
+        k: v for k, v in params.items()
+        if Config.resolve_alias(k) in ("num_machines", "machines",
+                                       "time_out")})
+    if net_cfg.num_machines > 1 and net_cfg.machines:
+        from .network import ensure_distributed
+        ensure_distributed(net_cfg.machines, net_cfg.num_machines,
+                           time_out=net_cfg.time_out)
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")

@@ -258,8 +258,14 @@ class BinnedDataset:
         (validation data; reference Dataset::CreateValid, dataset.cpp).
         """
         sparse_input = _is_sparse(data)
+        data_csr = None
         if sparse_input:
             import scipy.sparse as sp
+            # keep the CSR form (when that is what arrived) for the
+            # row-sampling step below: re-deriving CSR from the CSC of
+            # a multi-billion-nnz matrix is a second full sort + copy
+            if sp.isspmatrix_csr(data):
+                data_csr = data
             data = data.tocsc() if not sp.isspmatrix_csc(data) else data
         else:
             data = np.asarray(data)
@@ -304,8 +310,11 @@ class BinnedDataset:
         rng = np.random.RandomState(config.data_random_seed)
         if sample_cnt < n:
             sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
-            sample = data.tocsr()[sample_idx].tocsc() if sparse_input \
-                else data[sample_idx]
+            if sparse_input:
+                rows = data_csr if data_csr is not None else data.tocsr()
+                sample = rows[sample_idx].tocsc()
+            else:
+                sample = data[sample_idx]
         else:
             sample = data
         if not sparse_input:
@@ -331,6 +340,18 @@ class BinnedDataset:
             mappers = distributed_find_bin_mappers(
                 np.asarray(sample, dtype=np.float64), config, cat_set)
         else:
+            if config.num_machines > 1 and sparse_input:
+                # the ownership-partition/allgather protocol consumes a
+                # dense sample; in single-controller mode the local path
+                # below produces BIT-IDENTICAL boundaries (the protocol
+                # bins each rank's owned features over the same full
+                # sample — see distributed_find_bin_mappers), so this
+                # fallback changes work placement only, never bins
+                log.warning(
+                    "num_machines=%d with sparse input: bin finding "
+                    "runs single-machine (boundaries identical to the "
+                    "distributed protocol in single-controller mode)",
+                    config.num_machines)
             mappers = cls._find_bin_mappers_local(
                 sample_col_nonzeros, total_features, sample_cnt, config,
                 cat_set)

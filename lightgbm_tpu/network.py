@@ -1,0 +1,138 @@
+"""Multi-host process wiring — the Network::Init seam, JAX-style.
+
+Reference analogue: src/network/ builds a TCP/MPI collective stack from
+a machine list and Network::Init is called before training
+(application.cpp:164-175; LGBM_NetworkInit / set_network through the
+C API, c_api.cpp:2262). The TPU framework needs none of that collective
+code — XLA provides the collectives over ICI/DCN — but the PROCESS
+wiring seam still exists: a multi-host job runs one Python process per
+host, and `jax.distributed.initialize(coordinator, num_processes,
+process_id)` is what fuses their local devices into the one global
+device set that `jax.devices()` / `Mesh` then see.
+
+Launch recipe (documented in docs/MULTIHOST.md): run the SAME training
+script on every host with `machines=ip1:port,ip2:port,...` and
+`num_machines=K` (reference-compatible parameters); rank is discovered
+by matching local addresses against the machine list, exactly like the
+reference's socket linker (linkers_socket.cpp:36-48). Host 0's entry
+doubles as the JAX coordinator address. Alternatively set the standard
+JAX env vars (JAX_COORDINATOR_ADDRESS etc.) or run under a cluster
+manager jax.distributed auto-detects, and leave machines empty.
+"""
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Sequence
+
+from .utils import log
+
+
+def parse_machine_list(machines: str) -> List[str]:
+    """'ip1:port1,ip2:port2' -> ['ip1:port1', ...] (reference
+    Config::machines / machine_list_filename format)."""
+    out = []
+    for part in str(machines).replace("\n", ",").split(","):
+        part = part.strip()
+        if part:
+            out.append(part)
+    return out
+
+
+def local_addresses() -> List[str]:
+    """Addresses that identify THIS host (hostname, resolved IPs,
+    loopback) — the rank-discovery probe set (reference
+    linkers_socket.cpp:36-48 matches local interface IPs the same
+    way)."""
+    addrs = {"127.0.0.1", "localhost"}
+    try:
+        host = socket.gethostname()
+        addrs.add(host)
+        try:
+            addrs.update(info[4][0] for info in socket.getaddrinfo(
+                host, None, family=socket.AF_INET))
+        except socket.gaierror:
+            pass
+        # the address used for outward traffic (no packets are sent)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            addrs.add(s.getsockname()[0])
+        except OSError:
+            pass
+        finally:
+            s.close()
+    except OSError:
+        pass
+    return sorted(addrs)
+
+
+def resolve_rank(machines: Sequence[str],
+                 local: Optional[Sequence[str]] = None) -> Optional[int]:
+    """Index of this host in the machine list, or None when absent."""
+    if local is None:
+        local = local_addresses()
+    local_set = set(local)
+    for rank, entry in enumerate(machines):
+        host = entry.rsplit(":", 1)[0]
+        if host in local_set:
+            return rank
+    return None
+
+
+def ensure_distributed(machines: str = "", num_machines: int = 1,
+                       time_out: int = 120,
+                       _initialize=None) -> bool:
+    """Initialize jax.distributed for a real multi-host run (no-op when
+    already initialized, or when the config is single-machine, or when
+    every listed machine resolves to this host — the single-controller
+    multi-chip case, where num_machines is only a work-partitioning
+    parameter).
+
+    Returns True when a multi-process runtime is active after the call.
+    `time_out` is in MINUTES (the reference's time_out/listen_time_out
+    config unit); it converts to seconds at the jax.distributed
+    boundary. `_initialize` is injectable for tests (defaults to
+    jax.distributed.initialize).
+    """
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", None) and \
+            jax.distributed.is_initialized():
+        return True
+    if num_machines <= 1:
+        return False
+    mlist = parse_machine_list(machines)
+    if not mlist:
+        # no machine list: defer to env/cluster auto-detection only if
+        # the standard env vars are present; otherwise this is the
+        # single-controller case (one process drives all local chips)
+        import os
+        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            init = _initialize or jax.distributed.initialize
+            init()   # fully env-driven
+            return True
+        return False
+    if len(mlist) != num_machines:
+        log.warning("machines lists %d entries but num_machines=%d; "
+                    "using the list length", len(mlist), num_machines)
+        num_machines = len(mlist)
+    rank = resolve_rank(mlist)
+    if rank is None:
+        log.fatal("This host's addresses %s match no entry of the "
+                  "machine list %s (reference socket-linker rank "
+                  "discovery)", local_addresses(), mlist)
+    if num_machines == 1 or all(
+            resolve_rank([m]) is not None for m in mlist):
+        # every entry is this host: single-process multi-chip run
+        log.info("All %d machine-list entries resolve locally: "
+                 "single-controller mode (no jax.distributed)",
+                 len(mlist))
+        return False
+    init = _initialize or jax.distributed.initialize
+    init(coordinator_address=mlist[0], num_processes=num_machines,
+         process_id=rank,
+         initialization_timeout=int(time_out) * 60)
+    log.info("jax.distributed initialized: rank %d/%d, coordinator %s "
+             "(Network::Init analogue; collectives ride ICI/DCN via "
+             "XLA)", rank, num_machines, mlist[0])
+    return True

@@ -34,9 +34,11 @@ routed through the partition kernel's prefetched scalars), serial and
 sharded-data-parallel learners, any objective without leaf renewal,
 bagging via a host-provided permutation, per-tree feature_fraction,
 max_depth, basic monotone constraints, L1/L2/max_delta_step/path
-smoothing. Forced splits, interaction constraints,
-feature_fraction_bynode, CEGB and renew-tree-output objectives fall
-back to the host-loop grower (treelearner/serial.py).
+smoothing, forced splits (BFS phase before the best-first loop) and
+feature_fraction_bynode (per-scan-event masks). Interaction
+constraints, extra_trees, CEGB and renew-tree-output objectives fall
+back to the host-loop grower (treelearner/serial.py) — every rejection
+is named by fused_reject_reason and warned about loudly.
 """
 from __future__ import annotations
 
@@ -60,37 +62,55 @@ from ..utils import log
 NEG_INF = jnp.float32(-jnp.inf)
 
 
-def fused_supported(config: Config, dataset: BinnedDataset,
-                    objective) -> bool:
-    """Static eligibility check for the fused path."""
+def fused_reject_reason(config: Config, dataset: BinnedDataset,
+                        objective) -> Optional[str]:
+    """Why a config cannot run the fused single-dispatch path (None =
+    eligible). Every remaining rejection names the responsible option so
+    the driver can warn LOUDLY about the ~10x host-loop perf cliff."""
     if not config.tpu_fused:
-        return False
+        return "tpu_fused=false"
     if config.tree_learner != "serial":
-        return False
+        return f"tree_learner={config.tree_learner}"
     if max((m.num_bin for m in dataset.bin_mappers
             if m.bin_type == BIN_CATEGORICAL), default=0) > 256:
         # categorical routing carries an 8-word (256-bin) bitset through
         # the partition kernel's prefetched scalars
-        return False
-    if config.forcedsplits_filename or config.interaction_constraints:
-        return False
-    if config.feature_fraction_bynode < 1.0 or config.extra_trees:
-        return False
+        return "a categorical feature with > 256 bins (max_bin)"
+    if config.forcedsplits_filename:
+        # the forced phase reads parent histograms from the pool
+        pool_mb = config.histogram_pool_size
+        need = (max(config.num_leaves, 2) * dataset.num_features
+                * max((m.num_bin for m in dataset.bin_mappers), default=2)
+                * 2 * 4)
+        if not (pool_mb <= 0 or need <= pool_mb * 1024 * 1024):
+            return ("forcedsplits_filename with a histogram_pool_size "
+                    "too small for the dense pool")
+    if config.interaction_constraints:
+        return "interaction_constraints"
+    if config.extra_trees:
+        return "extra_trees"
     if (config.cegb_tradeoff != 1.0 or config.cegb_penalty_split > 0
             or config.cegb_penalty_feature_coupled
             or config.cegb_penalty_feature_lazy):
-        return False
+        return "cegb_* (cost-effective gradient boosting)"
     if config.monotone_constraints and (
             config.monotone_constraints_method != "basic"
             or config.monotone_penalty > 0):
         # intermediate mode re-searches arbitrary leaves after a split —
         # host-loop territory (treelearner/monotone.py)
-        return False
+        return ("monotone_constraints_method=intermediate or "
+                "monotone_penalty > 0")
     if objective is not None and objective.is_renew_tree_output:
-        return False
+        return f"objective={objective.name} (renew-tree-output leaf refit)"
     if dataset.num_features == 0:
-        return False
-    return True
+        return "dataset has no usable features"
+    return None
+
+
+def fused_supported(config: Config, dataset: BinnedDataset,
+                    objective) -> bool:
+    """Static eligibility check for the fused path."""
+    return fused_reject_reason(config, dataset, objective) is None
 
 
 class FusedTreeState(NamedTuple):
@@ -233,6 +253,46 @@ class FusedSerialGrower:
             log.info("histogram pool (%.0f MB) exceeds histogram_pool_size"
                      "=%.0f MB: disabling histogram subtraction",
                      need / 1e6, pool_mb)
+
+        # user-forced splits: BFS schedule precomputed host-side
+        # (leaf slot / inner feature / threshold bin per forced split);
+        # the slot ids replay exactly the fused state's deterministic
+        # slot assignment (split leaf keeps its slot, right child takes
+        # slot n_leaves). Reference: ForceSplits,
+        # serial_tree_learner.cpp:427
+        self._forced_sched = None
+        if config.forcedsplits_filename:
+            from .serial import _load_forced_splits
+            forced = _load_forced_splits(config.forcedsplits_filename)
+            sched = []
+            if forced is not None:
+                queue = [(forced, 0)]
+                nl = 1
+                while queue and nl < self.num_leaves:
+                    node, slot = queue.pop(0)
+                    rf = node.get("feature")
+                    if rf is None:
+                        continue
+                    inner = dataset.inner_feature_index.get(int(rf))
+                    if inner is None:
+                        log.warning("Forced split on unused feature %s "
+                                    "ignored", rf)
+                        continue
+                    m = mappers[inner]
+                    tb = int(m.value_to_bin(float(node["threshold"])))
+                    tb = max(0, min(tb, m.num_bin - 2))
+                    sched.append((slot, inner, tb))
+                    right_slot = nl
+                    nl += 1
+                    if isinstance(node.get("left"), dict):
+                        queue.append((node["left"], slot))
+                    if isinstance(node.get("right"), dict):
+                        queue.append((node["right"], right_slot))
+            if sched:
+                arr = np.asarray(sched, np.int32)
+                self._forced_sched = (jnp.asarray(arr[:, 0]),
+                                      jnp.asarray(arr[:, 1]),
+                                      jnp.asarray(arr[:, 2]))
 
         # score updates can reuse the partition's leaf assignment only
         # when every scored row is in-bag (no bagging/GOSS/RF); with
@@ -434,13 +494,16 @@ class FusedSerialGrower:
         return jnp.stack(words)
 
     def _scan_two_leaves(self, hist2, sum_g2, sum_h2, count2, output2,
-                         cmin2, cmax2, feature_mask):
+                         cmin2, cmax2, feature_mask2):
         """Both children's best splits from one vmapped scan (halves the
-        per-split scan kernel count vs two sequential _scan_leaf calls)."""
+        per-split scan kernel count vs two sequential _scan_leaf calls).
+        feature_mask2: [2, F] — per-child masks (identical rows unless
+        feature_fraction_bynode is active)."""
         res2 = jax.vmap(
-            lambda h, sg, sh, c, o, lo, hi: self._scan_leaf(
-                h, sg, sh, c, o, lo, hi, feature_mask)
-        )(hist2, sum_g2, sum_h2, count2, output2, cmin2, cmax2)
+            lambda h, sg, sh, c, o, lo, hi, m: self._scan_leaf(
+                h, sg, sh, c, o, lo, hi, m)
+        )(hist2, sum_g2, sum_h2, count2, output2, cmin2, cmax2,
+          feature_mask2)
         first = {k: v[0] for k, v in res2.items()}
         second = {k: v[1] for k, v in res2.items()}
         return first, second
@@ -448,10 +511,14 @@ class FusedSerialGrower:
     # ------------------------------------------------------------------
     def _grow_tree_core(self, data, bag_cnt, feature_mask):
         """The while_loop tree builder over planar data. Returns
-        (tree arrays dict, final FusedTreeState)."""
+        (tree arrays dict, final FusedTreeState). feature_mask: [F]
+        per-tree mask, or [2L, F] per-scan-event masks (see
+        feature_masks_for_tree) — the rank is a static branch."""
         L = self.num_leaves
         F, B = self.num_features, self.max_num_bin
         f32, i32 = jnp.float32, jnp.int32
+        bynode = feature_mask.ndim == 2
+        root_mask = feature_mask[0] if bynode else feature_mask
 
         root_hist = self._psum(self._leaf_hist_switch(data, jnp.int32(0),
                                                       bag_cnt))
@@ -460,7 +527,7 @@ class FusedSerialGrower:
         sum_h = jnp.sum(root_hist[0, :, 1])
         root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt_g,
                                     f32(0.0), f32(-jnp.inf), f32(jnp.inf),
-                                    feature_mask)
+                                    root_mask)
 
         def arr(val, dtype=f32):
             return jnp.full((L,), val, dtype)
@@ -515,20 +582,38 @@ class FusedSerialGrower:
                 gains = jnp.where(st.leaf_depth >= max_depth, NEG_INF, gains)
             return (st.n_leaves < L) & (jnp.max(gains) > 0.0)
 
-        def body(st: FusedTreeState) -> FusedTreeState:
-            gains = st.best_gain
-            if max_depth > 0:
-                gains = jnp.where(st.leaf_depth >= max_depth, NEG_INF, gains)
-            leaf = jnp.argmax(gains).astype(i32)
+        def body(st: FusedTreeState, rec=None) -> FusedTreeState:
+            """One split step. rec=None: split the best-gain leaf with
+            its scanned best (the while_loop body). rec given: apply a
+            FORCED split (leaf, feature, threshold fixed; sums computed
+            from the pooled histogram) — reference ForceSplits,
+            serial_tree_learner.cpp:427."""
+            if rec is None:
+                gains = st.best_gain
+                if max_depth > 0:
+                    gains = jnp.where(st.leaf_depth >= max_depth, NEG_INF,
+                                      gains)
+                leaf = jnp.argmax(gains).astype(i32)
+                feat = st.best_feature[leaf]
+                thr = st.best_thr[leaf]
+                dl = st.best_dl[leaf]
+                cat = st.best_cat[leaf]
+                bits = st.best_bits[leaf]
+                rec = dict(
+                    gain=st.best_gain[leaf],
+                    lg=st.best_lg[leaf], lh=st.best_lh[leaf],
+                    lout=st.best_lout[leaf],
+                    rg=st.best_rg[leaf], rh=st.best_rh[leaf],
+                    rout=st.best_rout[leaf])
+            else:
+                leaf = rec["leaf"]
+                feat, thr = rec["feature"], rec["threshold"]
+                dl = rec["dl"]
+                cat = jnp.bool_(False)
+                bits = jnp.zeros(8, i32)
             node = st.n_leaves - 1
             new_leaf = st.n_leaves
-
-            feat = st.best_feature[leaf]
-            thr = st.best_thr[leaf]
-            dl = st.best_dl[leaf]
             miss = self.feature_miss_bin[feat]
-            cat = st.best_cat[leaf]
-            bits = st.best_bits[leaf]
 
             # --- tree bookkeeping (Tree::Split semantics, tree.h:61) ---
             parent = st.leaf_parent[leaf]
@@ -545,7 +630,7 @@ class FusedSerialGrower:
             t_dl = st.t_dl.at[node].set(dl)
             t_left = t_left.at[node].set(~leaf)
             t_right = t_right.at[node].set(~new_leaf)
-            t_gain = st.t_gain.at[node].set(st.best_gain[leaf])
+            t_gain = st.t_gain.at[node].set(rec["gain"])
             t_ivalue = st.t_ivalue.at[node].set(st.leaf_output[leaf])
             t_iweight = st.t_iweight.at[node].set(st.leaf_sum_h[leaf])
             t_icount = st.t_icount.at[node].set(st.leaf_count_g[leaf])
@@ -572,7 +657,7 @@ class FusedSerialGrower:
                 self._leaf_hist_switch(new_data, s_start, s_count))
 
             # --- children bookkeeping ---
-            lout, rout = st.best_lout[leaf], st.best_rout[leaf]
+            lout, rout = rec["lout"], rec["rout"]
             depth = st.leaf_depth[leaf] + 1
             cmin, cmax = st.leaf_cmin[leaf], st.leaf_cmax[leaf]
             if self.use_monotone:
@@ -590,10 +675,10 @@ class FusedSerialGrower:
                                        .at[new_leaf].set(nright)
             leaf_count_g = st.leaf_count_g.at[leaf].set(nleft_g)\
                                           .at[new_leaf].set(nright_g)
-            leaf_sum_g = st.leaf_sum_g.at[leaf].set(st.best_lg[leaf])\
-                                      .at[new_leaf].set(st.best_rg[leaf])
-            leaf_sum_h = st.leaf_sum_h.at[leaf].set(st.best_lh[leaf])\
-                                      .at[new_leaf].set(st.best_rh[leaf])
+            leaf_sum_g = st.leaf_sum_g.at[leaf].set(rec["lg"])\
+                                      .at[new_leaf].set(rec["rg"])
+            leaf_sum_h = st.leaf_sum_h.at[leaf].set(rec["lh"])\
+                                      .at[new_leaf].set(rec["rh"])
             leaf_output = st.leaf_output.at[leaf].set(lout)\
                                         .at[new_leaf].set(rout)
             leaf_depth = st.leaf_depth.at[leaf].set(depth)\
@@ -621,14 +706,19 @@ class FusedSerialGrower:
                 hist_pool = st.hist_pool
 
             # --- best splits for both children (one vmapped scan) ---
+            if bynode:
+                mask2 = jnp.stack([feature_mask[2 * new_leaf - 1],
+                                   feature_mask[2 * new_leaf]])
+            else:
+                mask2 = jnp.stack([feature_mask, feature_mask])
             bl, br = self._scan_two_leaves(
                 jnp.stack([hist_left, hist_right]),
-                jnp.stack([st.best_lg[leaf], st.best_rg[leaf]]),
-                jnp.stack([st.best_lh[leaf], st.best_rh[leaf]]),
+                jnp.stack([rec["lg"], rec["rg"]]),
+                jnp.stack([rec["lh"], rec["rh"]]),
                 jnp.stack([nleft_g, nright_g]),
                 jnp.stack([lout, rout]),
                 jnp.stack([lcmin, rcmin]),
-                jnp.stack([lcmax, rcmax]), feature_mask)
+                jnp.stack([lcmax, rcmax]), mask2)
 
             def upd(a, key, cast=lambda x: x):
                 return a.at[leaf].set(cast(bl[key])).at[new_leaf].set(cast(br[key]))
@@ -660,6 +750,72 @@ class FusedSerialGrower:
                 t_iweight=t_iweight, t_icount=t_icount,
                 t_cat=t_cat, t_bits=t_bits,
             )
+
+        # --- user-forced splits first (BFS schedule precomputed on the
+        # host; reference SerialTreeLearner::ForceSplits,
+        # serial_tree_learner.cpp:427) ---
+        if self._forced_sched is not None:
+            f_leaf, f_feat, f_thr = self._forced_sched
+            eps = S.K_EPSILON
+            B = self.max_num_bin
+
+            def forced_step(carry, k):
+                st, alive = carry
+                leaf = f_leaf[k]
+                feat = f_feat[k]
+                thr = f_thr[k]
+                hist = st.hist_pool[leaf]            # [F, B, 2]
+                h = jnp.sum(jnp.where(
+                    (jnp.arange(F, dtype=i32) == feat)[:, None, None],
+                    hist, 0.0), axis=0)              # [B, 2], no gather
+                bidx = jnp.arange(B, dtype=i32)
+                miss = self.feature_miss_bin[feat]
+                sel = ((bidx <= thr) &
+                       jnp.where(miss >= 0, bidx != miss, True))
+                selm = sel.astype(f32)
+                lg = jnp.sum(selm * h[:, 0])
+                lh = jnp.sum(selm * h[:, 1])
+                sum_g_l = st.leaf_sum_g[leaf]
+                sum_h_l = st.leaf_sum_h[leaf]
+                rg = sum_g_l - lg
+                rh = sum_h_l - lh
+                cntf = st.leaf_count_g[leaf].astype(f32) \
+                    / (sum_h_l + 2 * eps)
+                lcnt = jnp.floor(lh * cntf + 0.5).astype(i32)
+                parent_out = st.leaf_output[leaf]
+                cmin, cmax = st.leaf_cmin[leaf], st.leaf_cmax[leaf]
+                # full CalculateSplittedLeafOutput semantics (L1/L2,
+                # max_delta_step, path smoothing, monotone clamp) — the
+                # same helper every scanned split uses
+                lout = S._calc_output(lg, lh + eps, lcnt, self.split_cfg,
+                                      parent_out, cmin, cmax)
+                rout = S._calc_output(
+                    rg, rh + eps, st.leaf_count_g[leaf] - lcnt,
+                    self.split_cfg, parent_out, cmin, cmax)
+                rec = dict(leaf=leaf, feature=feat, threshold=thr,
+                           dl=jnp.bool_(False), gain=f32(0.0),
+                           lg=lg, lh=lh, lout=lout,
+                           rg=rg, rh=rh, rout=rout)
+                # gate on hessian MASS per side (a truly empty side has
+                # exactly zero mass; counts are hessian-derived
+                # estimates in this design, ops/split.py:18, and could
+                # round a small-but-real side to 0)
+                ok = (alive & (lh > 1e-9) & (rh > 1e-9)
+                      & (st.n_leaves < L)
+                      & (st.leaf_count_g[leaf] > 0))
+                st = jax.lax.cond(ok, lambda s: body(s, rec=rec),
+                                  lambda s: s, st)
+                # the host-precomputed slot schedule assumes every
+                # earlier forced split succeeded; once one is skipped,
+                # later slot ids would alias the wrong leaves — stop
+                # forcing (conservative vs the reference's dynamic BFS:
+                # the remaining forced splits are left to the normal
+                # gain-driven loop)
+                return (st, alive & ok), ()
+
+            (st, _alive), _ = jax.lax.scan(
+                forced_step, (st, jnp.bool_(True)),
+                jnp.arange(f_leaf.shape[0]))
 
         st = jax.lax.while_loop(cond, body, st)
 
@@ -761,7 +917,7 @@ class FusedSerialGrower:
             g, h = grad[perm_dev], hess[perm_dev]
             bins_arg = self.bins
         return self._grow_jit(cp, g, h, perm_dev, jnp.int32(bag_cnt),
-                              self.feature_mask_tree(), bins_arg,
+                              self.feature_masks_for_tree(), bins_arg,
                               compute_score_update=compute_score_update)
 
     # -- persistent mode -----------------------------------------------
@@ -810,7 +966,7 @@ class FusedSerialGrower:
 
     def train_iter_persistent(self, data, shrinkage, bias, mask=None):
         if mask is None:
-            mask = self.feature_mask_tree()
+            mask = self.feature_masks_for_tree()
         return self._iter_jit(data, mask, jnp.float32(shrinkage),
                               jnp.float32(bias))
 
@@ -903,21 +1059,47 @@ class FusedSerialGrower:
         return -node - 1
 
     # ------------------------------------------------------------------
-    def feature_mask_tree(self) -> jax.Array:
+    def _tree_mask_np(self) -> np.ndarray:
         f = self.num_features
+        mask = np.ones(f, dtype=bool)
         frac = self.config.feature_fraction
-        if frac >= 1.0:
+        if frac < 1.0:
+            k = max(1, int(np.ceil(frac * f)))
+            chosen = self._col_rng.choice(f, size=k, replace=False)
+            mask[:] = False
+            mask[chosen] = True
+        return mask
+
+    def feature_mask_tree(self) -> jax.Array:
+        if self.config.feature_fraction >= 1.0:
             # constant all-ones mask: upload ONCE. A fresh jnp.asarray
             # per iteration is a host->device transfer on the dispatch
             # path of every tree (~100 ms tunnel latency class)
             if getattr(self, "_mask_ones_dev", None) is None:
-                self._mask_ones_dev = jnp.ones(f, dtype=bool)
+                self._mask_ones_dev = jnp.ones(self.num_features,
+                                               dtype=bool)
             return self._mask_ones_dev
-        mask = np.zeros(f, dtype=bool)
-        k = max(1, int(np.ceil(frac * f)))
-        chosen = self._col_rng.choice(f, size=k, replace=False)
-        mask[chosen] = True
-        return jnp.asarray(mask)
+        return jnp.asarray(self._tree_mask_np())
+
+    def feature_masks_for_tree(self) -> jax.Array:
+        """Per-tree scan masks: [F] (by-tree sampling only) or
+        [2L, F] per-scan-event masks when feature_fraction_bynode < 1
+        (col_sampler.hpp GetByNode semantics: a fresh k-subset of the
+        tree's selected features per candidate node; event 0 = root
+        scan, events 2*new_leaf-1 / 2*new_leaf = the two children of
+        the split that created leaf slot new_leaf). The shape is a
+        static trace-time branch in _grow_tree_core."""
+        frac = self.config.feature_fraction_bynode
+        if frac >= 1.0:
+            return self.feature_mask_tree()
+        tm = self._tree_mask_np()
+        idx = np.flatnonzero(tm)
+        k = max(1, int(np.ceil(frac * len(idx))))
+        E = 2 * self.num_leaves
+        masks = np.zeros((E, self.num_features), dtype=bool)
+        for e in range(E):
+            masks[e, self._col_rng.choice(idx, size=k, replace=False)] = True
+        return jnp.asarray(masks)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _valid_traverse_jit(self, ta, bins):

@@ -536,7 +536,7 @@ class FusedDataParallelGrower(FusedSerialGrower):
     # -- sharded iteration ---------------------------------------------
     def train_iter_persistent(self, data, shrinkage, bias, mask=None):
         if mask is None:
-            mask = self.feature_mask_tree()
+            mask = self.feature_masks_for_tree()
         if self._iter_mc_jit is None:
             def body(data_l, nvalid_l, mask_, shr, b):
                 return self._train_iter(data_l, mask_, shr, b,
@@ -671,7 +671,7 @@ class FusedDataParallelGrower(FusedSerialGrower):
             self._grow_mc_tree_jit = self._grow_mc_jit_build()
         ta, leaf = self._grow_mc_tree_jit(
             self._bins_row_sharded(), perm_dev, counts_dev,
-            pad_rows(grad), pad_rows(hess), self.feature_mask_tree())
+            pad_rows(grad), pad_rows(hess), self.feature_masks_for_tree())
         leaf_of_row = leaf.reshape(-1)[:n] if compute_score_update else None
         return ta, leaf_of_row
 

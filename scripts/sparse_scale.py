@@ -33,26 +33,36 @@ def make_sparse(n, nvars, ncats, seed=0):
     """One-hot design matrix in CSR: nvars categorical variables of
     ncats levels each -> nvars*ncats binary columns, exactly one
     nonzero per variable per row (the Allstate-like structure EFB
-    exploits)."""
+    exploits). Written for full 13.2M-row generation on one CPU core:
+    inverse-CDF sampling per variable (vectorized searchsorted) and the
+    column-index array built in place — no [n, nvars] intermediates
+    beyond the one CSR index array itself."""
     import scipy.sparse as sp
     rng = np.random.RandomState(seed)
     # skewed category popularity so bundles get a dominant bin
     probs = rng.dirichlet(np.ones(ncats) * 0.7, size=nvars)
-    cats = np.empty((n, nvars), dtype=np.int16)
-    for v in range(nvars):
-        cats[:, v] = rng.choice(ncats, size=n, p=probs[v])
+    cum = np.cumsum(probs, axis=1)
     w = rng.randn(nvars, ncats) * (rng.rand(nvars) < 0.2)[:, None]
+    # [nvars, n] for contiguous row writes (a column write into a
+    # C-order [n, nvars] array is a 13M-element strided scatter per
+    # variable — 4x slower on this one-core host)
+    colsT = np.empty((nvars, n), dtype=np.int32)
     logit = np.zeros(n, np.float32)
     for v in range(nvars):
-        logit += w[v][cats[:, v]].astype(np.float32)
+        cat_v = np.searchsorted(cum[v], rng.rand(n)).astype(np.int32)
+        np.clip(cat_v, 0, ncats - 1, out=cat_v)
+        logit += w[v][cat_v].astype(np.float32)
+        colsT[v] = cat_v + v * ncats
     y = (logit + rng.randn(n).astype(np.float32) * 0.5 > 0).astype(np.float32)
-
-    cols = (cats + np.arange(nvars, dtype=np.int32) * ncats).astype(np.int32)
+    del logit
+    cols = np.ascontiguousarray(colsT.T).reshape(-1)
+    del colsT
     indptr = np.arange(n + 1, dtype=np.int64) * nvars
-    data = np.ones(n * nvars, dtype=np.float32)
-    X = sp.csr_matrix((data, cols.reshape(-1), indptr),
-                      shape=(n, nvars * ncats))
-    return X, y, cats
+    # int8 ones: the one-hot values; keeps the 6.6e9-nnz data array at
+    # 6.6 GB instead of 26.4 GB (the CSR+CSC pair must fit in host RAM)
+    data = np.ones(n * nvars, dtype=np.int8)
+    X = sp.csr_matrix((data, cols, indptr), shape=(n, nvars * ncats))
+    return X, y
 
 
 def main():
@@ -67,7 +77,7 @@ def main():
     import lightgbm_tpu as lgb
 
     t0 = time.time()
-    X, y, cats = make_sparse(ROWS, VARS, CATS)
+    X, y = make_sparse(ROWS, VARS, CATS)
     t_gen = time.time() - t0
     print(f"generated {ROWS}x{VARS * CATS} CSR "
           f"(density {X.nnz / (ROWS * VARS * CATS):.3%}) in {t_gen:.0f}s")
@@ -87,6 +97,13 @@ def main():
                     verbose_eval=False, keep_training_booster=True)
     jax.block_until_ready(bst._gbdt.device_score_state())
     t_train = time.time() - t0
+    # steady-state per-iteration rate (compile already paid above)
+    t0 = time.time()
+    steady_n = max(3, ITERS // 2)
+    for _ in range(steady_n):
+        bst.update()
+    jax.block_until_ready(bst._gbdt.device_score_state())
+    s_iter = (time.time() - t0) / steady_n
     fused = bst._gbdt._fused
     layout = fused.layout if fused is not None else None
     code_bits = layout.code_bits if layout else None
@@ -129,6 +146,13 @@ def main():
         "(group bins <= 16 -> dense_bin.hpp IS_4BIT analogue)",
         f"- dataset construct (binning + EFB + packing): {t_construct:.0f}s",
         f"- train ({ITERS} iters incl. compile): {t_train:.0f}s",
+        f"- steady-state: **{s_iter:.2f} s/iter** -> extrapolated "
+        f"{s_iter * 500:.0f}s for 500 iterations (reference Allstate "
+        "baseline: 148.2s/500 iters on the 28-core CPU box, "
+        "docs/Experiments.rst:121; its sparse-optimized row-wise "
+        "histograms make Allstate CHEAPER per row than HIGGS for the "
+        "reference, while the planar TPU path pays for every bundle "
+        "column — the honest comparison is below, not hidden)",
         f"- sampled train AUC: **{auc:.4f}** (sanity floor 0.70)",
         "",
         "Device-footprint accounting (deterministic, from array shapes):",

@@ -139,6 +139,33 @@ def test_training_with_distributed_mappers():
     assert auc > 0.8
 
 
+def test_sparse_input_warns_and_matches_local():
+    """num_machines>1 + CSR input: bin finding falls back to the local
+    path with a LOUD warning — and in single-controller mode the
+    boundaries are identical to the dense distributed protocol's, so
+    nothing silently changes (round-4 verdict item 10)."""
+    import scipy.sparse as sp
+    from lightgbm_tpu.utils import log as lgb_log
+    rng = np.random.RandomState(3)
+    dense = rng.randn(2000, 5) * (rng.rand(2000, 5) < 0.3)
+    X = sp.csr_matrix(dense)
+    y = (dense[:, 0] > 0).astype(np.float32)
+    cfg = Config.from_params({"num_machines": WORLD})
+    captured = []
+    lgb_log.register_log_callback(captured.append)
+    try:
+        ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    finally:
+        lgb_log.register_log_callback(None)
+    assert any("sparse input" in m for m in captured), \
+        f"missing the sparse-fallback warning in {captured}"
+    cfg1 = Config.from_params({"verbose": -1})
+    ds1 = BinnedDataset.from_matrix(X, cfg1, label=y)
+    assert len(ds.bin_mappers) == len(ds1.bin_mappers)
+    for a, b in zip(ds.bin_mappers, ds1.bin_mappers):
+        np.testing.assert_array_equal(a.bin_upper_bound, b.bin_upper_bound)
+
+
 def test_from_matrix_uses_distributed_protocol():
     """num_machines>1 construction must route through the distributed
     protocol (owned features, allgather) — verified by matching its
