@@ -77,7 +77,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         k: v for k, v in params.items()
         if Config.resolve_alias(k) in ("num_machines", "machines",
                                        "time_out")})
-    if net_cfg.num_machines > 1 and net_cfg.machines:
+    if net_cfg.num_machines > 1:
+        # with an empty machine list this is env-driven
+        # (JAX_COORDINATOR_ADDRESS) or a single-controller no-op —
+        # ensure_distributed sorts the cases out
         from .network import ensure_distributed
         ensure_distributed(net_cfg.machines, net_cfg.num_machines,
                            time_out=net_cfg.time_out)

@@ -64,6 +64,21 @@ def find_bundles(nonzero_rows: List[np.ndarray], num_bins: Sequence[int],
     group_marks: List[np.ndarray] = []   # bool over sample rows
     group_bins: List[int] = []
     group_confl: List[int] = []
+    # probe screen: a fixed random row subset lets ONE matvec estimate
+    # every group's conflict with a candidate feature, so the exact
+    # check only visits the most promising MAX_SEARCH_GROUP groups.
+    # The reference caps its search by sampling groups at RANDOM
+    # (dataset.cpp:132-143) — at thousands of columns that misses the
+    # compatible group most of the time; the probe finds it while the
+    # conflict budget is still enforced EXACTLY below.
+    probe_n = min(4096, sample_cnt)
+    probe_rng = np.random.RandomState(3)
+    probe_idx = np.sort(probe_rng.choice(sample_cnt, probe_n,
+                                         replace=False)) \
+        if probe_n < sample_cnt else np.arange(sample_cnt)
+    probe_lut = np.full(sample_cnt, -1, np.int64)
+    probe_lut[probe_idx] = np.arange(probe_n)
+    probe_mat = np.zeros((f_total, probe_n), np.float32)  # row g = group g
 
     for f in order:
         if not bundle_ok[f]:
@@ -73,22 +88,33 @@ def find_bundles(nonzero_rows: List[np.ndarray], num_bins: Sequence[int],
             group_confl.append(0)
             continue
         rows = nonzero_rows[f]
+        pf = probe_lut[rows]
+        pf = pf[pf >= 0]
+        pvec = np.zeros(probe_n, np.float32)
+        pvec[pf] = 1.0
         placed = False
-        searched = 0
-        for gid in range(len(group_members)):
-            if group_marks[gid] is None:
-                continue
-            if group_bins[gid] + num_bins[f] - 1 > max_bundle_bins:
-                continue
-            searched += 1
-            if searched > MAX_SEARCH_GROUP:
-                break
+        g_count = len(group_members)
+        gids = []
+        if g_count:
+            est = probe_mat[:g_count] @ pvec              # [G]
+            # ineligible / bin-budget-full groups can never accept the
+            # feature: push them past the end so they neither appear in
+            # the candidate order nor consume exact-check budget
+            blocked = np.fromiter(
+                (group_marks[g] is None
+                 or group_bins[g] + num_bins[f] - 1 > max_bundle_bins
+                 for g in range(g_count)), dtype=bool, count=g_count)
+            est[blocked] = np.inf
+            gids = np.argsort(est, kind="stable")[:MAX_SEARCH_GROUP]
+            gids = gids[np.isfinite(est[gids])]
+        for gid in gids:
             cnt = int(np.count_nonzero(group_marks[gid][rows]))
             if group_confl[gid] + cnt <= max_conflict:
                 group_members[gid].append(f)
                 group_marks[gid][rows] = True
                 group_bins[gid] += num_bins[f] - 1
                 group_confl[gid] += cnt
+                np.maximum(probe_mat[gid], pvec, out=probe_mat[gid])
                 placed = True
                 break
         if not placed:
@@ -98,6 +124,7 @@ def find_bundles(nonzero_rows: List[np.ndarray], num_bins: Sequence[int],
             group_marks.append(mark)
             group_bins.append(num_bins[f])
             group_confl.append(0)
+            probe_mat[len(group_members) - 1] = pvec
     return group_members
 
 

@@ -69,14 +69,22 @@ def local_addresses() -> List[str]:
 def resolve_rank(machines: Sequence[str],
                  local: Optional[Sequence[str]] = None) -> Optional[int]:
     """Index of this host in the machine list, or None when absent."""
+    matches = resolve_rank_all(machines, local)
+    return matches[0] if matches else None
+
+
+def resolve_rank_all(machines: Sequence[str],
+                     local: Optional[Sequence[str]] = None) -> List[int]:
+    """ALL machine-list indices whose host part matches this host (more
+    than one = several processes per host; the caller must disambiguate
+    by an explicit process id, since the list's ports describe the
+    peers' listen ports, not ours — reference linkers_socket.cpp
+    disambiguates with local_listen_port)."""
     if local is None:
         local = local_addresses()
     local_set = set(local)
-    for rank, entry in enumerate(machines):
-        host = entry.rsplit(":", 1)[0]
-        if host in local_set:
-            return rank
-    return None
+    return [rank for rank, entry in enumerate(machines)
+            if entry.rsplit(":", 1)[0] in local_set]
 
 
 def ensure_distributed(machines: str = "", num_machines: int = 1,
@@ -116,18 +124,34 @@ def ensure_distributed(machines: str = "", num_machines: int = 1,
         log.warning("machines lists %d entries but num_machines=%d; "
                     "using the list length", len(mlist), num_machines)
         num_machines = len(mlist)
-    rank = resolve_rank(mlist)
-    if rank is None:
+    local = local_addresses()
+    matches = resolve_rank_all(mlist, local)
+    if not matches:
         log.fatal("This host's addresses %s match no entry of the "
                   "machine list %s (reference socket-linker rank "
-                  "discovery)", local_addresses(), mlist)
-    if num_machines == 1 or all(
-            resolve_rank([m]) is not None for m in mlist):
+                  "discovery)", local, mlist)
+    if len(matches) == len(mlist):
         # every entry is this host: single-process multi-chip run
         log.info("All %d machine-list entries resolve locally: "
                  "single-controller mode (no jax.distributed)",
                  len(mlist))
         return False
+    if len(matches) > 1:
+        import os
+        env_rank = os.environ.get("JAX_PROCESS_ID",
+                                  os.environ.get("LGBM_TPU_RANK"))
+        if env_rank is None:
+            log.fatal("Machine list places %d processes on this host "
+                      "(%s); set JAX_PROCESS_ID (or LGBM_TPU_RANK) to "
+                      "pick this process's entry — the list's ports are "
+                      "the peers' listen ports and cannot disambiguate "
+                      "local processes", len(matches), matches)
+        rank = int(env_rank)
+        if rank not in matches:
+            log.fatal("JAX_PROCESS_ID=%d is not one of this host's "
+                      "machine-list entries %s", rank, matches)
+    else:
+        rank = matches[0]
     init = _initialize or jax.distributed.initialize
     init(coordinator_address=mlist[0], num_processes=num_machines,
          process_id=rank,

@@ -162,13 +162,25 @@ class FusedSerialGrower:
 
     is_multichip = False
 
+    @property
+    def bins(self):
+        if self._bins_dev is None:
+            self._bins_dev = self.dataset.device_bins()
+        return self._bins_dev
+
     def __init__(self, dataset: BinnedDataset, config: Config,
                  objective=None, num_rows_override=None) -> None:
         self.dataset = dataset
         self._num_rows_override = num_rows_override
         self.config = config
         self.objective = objective
-        self.bins = dataset.device_bins()
+        # HBM budgeting at wide-EFB scale: the row-major bin matrix is
+        # only needed by the traverse paths (OOB scores, valid sets,
+        # the bagging repack) — upload it LAZILY so the persistent path
+        # does not hold [N, G] u8 in HBM next to the planar state
+        # (13.2M x 500 groups = 6.6 GB that the training loop never
+        # reads)
+        self._bins_dev = None
         self.num_features = dataset.num_features
         mappers = dataset.bin_mappers
         self.max_num_bin = max((m.num_bin for m in mappers), default=2)
@@ -219,14 +231,15 @@ class FusedSerialGrower:
         # at 4 bits when every (bundle) column fits 16 bins — the
         # reference's DenseBin IS_4BIT mode (dense_bin.hpp:17-21),
         # halving code-plane HBM footprint and partition bandwidth.
-        self._num_cols = int(self.bins.shape[1])
+        self._num_cols = int(dataset.bins.shape[1])
         group_bins = (dataset.group_max_bins
                       if dataset.device_hist_tables() is not None
                       else self.max_num_bin)
         if group_bins <= 16:
             self._code_bits = 4
         else:
-            self._code_bits = 8 * int(np.dtype(self.bins.dtype).itemsize)
+            self._code_bits = 8 * int(
+                np.dtype(dataset.bins.dtype).itemsize)
         n = (dataset.num_data if num_rows_override is None
              else num_rows_override)
         persist = (objective is not None
@@ -239,6 +252,18 @@ class FusedSerialGrower:
             with_label=persist, with_score=persist, with_weight=has_w)
         self.persistent_capable = persist
         self._codes_planes_dev = None   # built lazily
+        # wide-EFB HBM budgeting: the v2 partition kernel's scratch is
+        # TWO window regions (L and R streams); when the planar state
+        # itself is multi-GB, v1's single-region scratch keeps
+        # state+scratch at 2x instead of 3x (the Allstate shape:
+        # ~60 code planes x 13.2M lanes)
+        if self._part_method == "pallas2":
+            state_gb = (self.layout.num_planes * self.layout.num_lanes
+                        * 4 / 1e9)
+            if state_gb > 2.5:
+                self._part_method = "pallas"
+                log.info("planar state %.1f GB: selecting the "
+                         "single-scratch partition kernel", state_gb)
 
         # histogram_pool_size (MB; <=0 unlimited — reference
         # feature_histogram.hpp:1061 HistogramPool): when the dense
@@ -335,8 +360,12 @@ class FusedSerialGrower:
     # ------------------------------------------------------------------
     def codes_planes(self) -> jax.Array:
         if self._codes_planes_dev is None:
+            # transient row-major upload when the device copy is not
+            # already resident (persistent path never needs it again)
+            src = self._bins_dev if self._bins_dev is not None \
+                else jnp.asarray(self.dataset.bins)
             self._codes_planes_dev = plane.build_codes_planes(
-                self.bins, self.layout)
+                src, self.layout)
         return self._codes_planes_dev
 
     def _switch_by_cap(self, count, branches_of_cap, *args):

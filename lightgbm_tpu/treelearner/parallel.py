@@ -509,7 +509,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
         D, sr, Ly = self.num_shards, self.shard_rows, self.layout
         aux_label, aux_weight = self.objective.persistent_aux()
         n = self.global_rows
-        bins_pad = jnp.pad(self.bins, ((0, D * sr - n), (0, 0)))
+        # host-side pad: reading the lazy `self.bins` property would
+        # upload + CACHE the full global row-major matrix on one device
+        # (the HBM waste the lazy property exists to avoid)
+        bins_pad = np.pad(np.asarray(self.dataset.bins),
+                          ((0, D * sr - n), (0, 0)))
         shards = []
         for d in range(D):
             cp = plane.build_codes_planes(
@@ -592,7 +596,7 @@ class FusedDataParallelGrower(FusedSerialGrower):
         persistent state: shard d owns rows [d*sr, (d+1)*sr))."""
         if getattr(self, "_bins_sh", None) is None:
             D, sr = self.num_shards, self.shard_rows
-            bins_np = np.asarray(self.bins)
+            bins_np = np.asarray(self.dataset.bins)
             pad = D * sr - bins_np.shape[0]
             if pad:
                 bins_np = np.pad(bins_np, ((0, pad), (0, 0)), mode="edge")
