@@ -955,7 +955,7 @@ class FusedSerialGrower:
         score_vec: [n] f32 current raw scores in ORIGINAL row order."""
         assert self.persistent_capable
         aux_label, aux_weight = self.objective.persistent_aux()
-        return plane.build_data(
+        data = plane.build_data(
             self.layout, self.codes_planes(),
             jnp.zeros(self.layout.num_rows, jnp.float32),
             jnp.zeros(self.layout.num_rows, jnp.float32),
@@ -963,6 +963,12 @@ class FusedSerialGrower:
             score=jnp.asarray(score_vec, jnp.float32),
             weight=(None if aux_weight is None
                     else jnp.asarray(aux_weight, jnp.float32)))
+        # the persistent program carries the codes INSIDE `data`; the
+        # cached planes copy would sit in HBM for nothing (3.9 GB at
+        # the Allstate shape, next to the state and the partition
+        # scratch). Drop it — the per-tree path rebuilds lazily.
+        self._codes_planes_dev = None
+        return data
 
     def _train_iter(self, data, feature_mask, shrinkage, bias,
                     n_valid=None):

@@ -1,7 +1,8 @@
 """Partition kernel correctness + throughput check on the real device.
 
-Compares partition_pallas against partition_ref on random states and
-times the kernel at HIGGS-ish window sizes. Run on TPU hardware.
+Compares BOTH production partition kernels (v1 `partition_pallas` and
+v2 `partition_pallas2`) against partition_ref on random states and
+times each at HIGGS-ish window sizes. Run on TPU hardware.
 """
 import os
 import sys
@@ -36,14 +37,17 @@ def check(n, g, start, count, feat, thr, seed, tile=2048):
     cap = -(-cap // tile) * tile
     ref, nl_ref = plane.partition_ref(data, layout, start, count, rscal,
                                       cap=cap)
-    got, nl_got = plane.partition_pallas(data, layout, start, count, rscal,
-                                         cap=cap)
-    jax.block_until_ready((ref, got))
-    ok_n = int(nl_ref) == int(nl_got)
-    ok_d = bool(jnp.all(ref == got))
-    print(f"n={n} start={start} count={count} cap={cap}: "
-          f"nleft ref={int(nl_ref)} got={int(nl_got)} data_equal={ok_d}")
-    return ok_n and ok_d, layout, data, rscal, cap
+    ok = True
+    for name, kern in (("v1", plane.partition_pallas),
+                       ("v2", plane.partition_pallas2)):
+        got, nl_got = kern(data, layout, start, count, rscal, cap=cap)
+        jax.block_until_ready((ref, got))
+        ok_d = bool(jnp.all(ref == got))
+        ok = ok and ok_d and int(nl_ref) == int(nl_got)
+        print(f"{name} n={n} start={start} count={count} cap={cap}: "
+              f"nleft ref={int(nl_ref)} got={int(nl_got)} "
+              f"data_equal={ok_d}")
+    return ok, layout, data, rscal, cap
 
 
 def main():
@@ -73,19 +77,20 @@ def main():
                             jnp.asarray(rng.rand(n).astype(np.float32)))
     cap = layout.num_lanes - layout.tile
     rscal = plane.route_scalars(layout, 5, 120, 1, 249)
-    d, nl = plane.partition_pallas(data, layout, 0, n, rscal, cap=cap)
-    jax.block_until_ready(d)
-    ts = []
-    for i in range(6):
-        rs2 = plane.route_scalars(layout, 5 + (i % 3), 100 + i, 1, 249)
-        t0 = time.perf_counter()
-        d, nl = plane.partition_pallas(data, layout, i, n - 2 * i, rs2,
-                                       cap=cap)
+    for name, kern in (("v1", plane.partition_pallas),
+                       ("v2", plane.partition_pallas2)):
+        d, nl = kern(data, layout, 0, n, rscal, cap=cap)
         jax.block_until_ready(d)
-        ts.append(time.perf_counter() - t0)
-    med = float(np.median(ts))
-    print(f"kernel @ {n} rows (P={layout.num_planes}): {med*1e3:.1f} ms "
-          f"-> {med/n*1e9:.2f} ns/row")
+        ts = []
+        for i in range(6):
+            rs2 = plane.route_scalars(layout, 5 + (i % 3), 100 + i, 1, 249)
+            t0 = time.perf_counter()
+            d, nl = kern(data, layout, i, n - 2 * i, rs2, cap=cap)
+            jax.block_until_ready(d)
+            ts.append(time.perf_counter() - t0)
+        med = float(np.median(ts))
+        print(f"{name} @ {n} rows (P={layout.num_planes}): "
+              f"{med*1e3:.1f} ms -> {med/n*1e9:.2f} ns/row")
 
 
 if __name__ == "__main__":

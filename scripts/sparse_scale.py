@@ -67,6 +67,7 @@ def make_sparse(n, nvars, ncats, seed=0):
 
 def main():
     import jax
+    T0 = time.time()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
         jax.config.update("jax_compilation_cache_dir",
@@ -165,11 +166,20 @@ def main():
         "(naive dense u8 would be "
         f"{ROWS * VARS * CATS / 1e9:.1f} GB — does not fit)",
         "",
-        f"Generated by scripts/sparse_scale.py; wall {time.time() - t0:.0f}s.",
+        f"Generated by scripts/sparse_scale.py; total wall "
+        f"{time.time() - T0:.0f}s.",
     ]
     out = os.path.join(repo, "docs", "SPARSE_SCALE.md")
+    # preserve hand-authored analysis sections (anything from a
+    # second-level heading that is not ours) across regeneration
+    manual = ""
+    if os.path.exists(out):
+        prev = open(out).read()
+        idx = prev.find("## Full-width finding")
+        if idx >= 0:
+            manual = "\n" + prev[idx:]
     with open(out, "w") as fh:
-        fh.write("\n".join(lines) + "\n")
+        fh.write("\n".join(lines) + "\n" + manual)
     print("\n".join(lines))
     assert auc > 0.70, "quality sanity failed"
 
