@@ -170,14 +170,16 @@ def main():
         f"{time.time() - T0:.0f}s.",
     ]
     out = os.path.join(repo, "docs", "SPARSE_SCALE.md")
-    # preserve hand-authored analysis sections (anything from a
-    # second-level heading that is not ours) across regeneration
+    # preserve hand-authored analysis across regeneration: everything
+    # from the FIRST second-level heading onward (the generated part
+    # above never emits one)
     manual = ""
     if os.path.exists(out):
-        prev = open(out).read()
-        idx = prev.find("## Full-width finding")
-        if idx >= 0:
-            manual = "\n" + prev[idx:]
+        prev_lines = open(out).read().splitlines(keepends=True)
+        for i, ln in enumerate(prev_lines):
+            if ln.startswith("## "):
+                manual = "\n" + "".join(prev_lines[i:])
+                break
     with open(out, "w") as fh:
         fh.write("\n".join(lines) + "\n" + manual)
     print("\n".join(lines))

@@ -158,3 +158,31 @@ def test_subset_keeps_bundles():
     sub = d.subset(np.arange(0, 300)).construct()
     assert sub._handle.bins.shape[1] == d._handle.bins.shape[1]
     np.testing.assert_array_equal(sub._handle.bins, d._handle.bins[:300])
+
+
+def test_probe_search_bundles_wide_one_hot():
+    """Round-4 regression: at hundreds of one-hot columns the greedy's
+    first-100-groups search missed the compatible group wholesale
+    (3968 cols -> 3272 groups at the Allstate shape); the probe screen
+    must find the one-bundle-per-variable grouping. 50 variables x 8
+    exclusive levels -> exactly-one-nonzero-per-variable rows must
+    bundle to ~#variables groups, not ~#columns."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(0)
+    n, nvars, ncats = 20000, 50, 8
+    cats = rng.randint(0, ncats, size=(n, nvars))
+    cols = (cats + np.arange(nvars) * ncats).astype(np.int32).reshape(-1)
+    X = sp.csr_matrix((np.ones(n * nvars, np.float32), cols,
+                       np.arange(n + 1, dtype=np.int64) * nvars),
+                      shape=(n, nvars * ncats))
+    y = (cats[:, 0] < 4).astype(np.float32)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    ds = BinnedDataset.from_matrix(X, Config.from_params({"verbose": -1}),
+                                   label=y)
+    groups = ds.bins.shape[1]
+    # ideal is ~nvars (one bundle per variable, plus a few singletons
+    # for dominant-level columns); the broken search gave ~#columns
+    assert groups <= nvars * 2, \
+        f"EFB bundled {nvars * ncats} cols into {groups} groups — " \
+        "probe search regressed"
