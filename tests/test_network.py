@@ -87,3 +87,23 @@ def test_booster_set_network_routes_through_ensure(monkeypatch):
                     listen_time_out=33, num_machines=2)
     assert seen == dict(machines="127.0.0.1:12400,127.0.0.1:12401",
                         num_machines=2, time_out=33)
+
+
+def test_ensure_distributed_multiple_local_entries(monkeypatch):
+    """Two processes on one host (mixed list): rank must come from
+    JAX_PROCESS_ID; without it the call must fail loudly rather than
+    start two rank-0 processes."""
+    import lightgbm_tpu.network as net
+    from lightgbm_tpu.utils.log import LightGBMError
+    monkeypatch.setattr(net, "local_addresses",
+                        lambda: ["10.8.0.1", "127.0.0.1"])
+    machines = "10.8.0.1:12400,10.8.0.1:12401,10.8.0.9:12400"
+    calls = []
+    with pytest.raises(LightGBMError):
+        ensure_distributed(machines, 3, _initialize=lambda **kw: None)
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    out = ensure_distributed(machines, 3,
+                             _initialize=lambda **kw: calls.append(kw))
+    assert out is True
+    assert calls[0]["process_id"] == 1
+    assert calls[0]["coordinator_address"] == "10.8.0.1:12400"
