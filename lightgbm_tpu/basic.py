@@ -118,11 +118,18 @@ class Dataset:
             return
         from .io.text_loader import load_text_file
         cfg = Config.from_params(self.params)
-        mat, label, weight, group = load_text_file(path, cfg)
+        if ref is not None and cfg.initscore_filename:
+            # the initscore_filename override names the TRAINING init
+            # file; validation sets keep the <data>.init sidecar
+            # convention (reference metadata.cpp LoadInitialScore)
+            import dataclasses
+            cfg = dataclasses.replace(cfg, initscore_filename="")
+        mat, label, weight, group, init_score = load_text_file(path, cfg)
         feature_names = [f"Column_{i}" for i in range(mat.shape[1])]
         cat = self._resolve_categorical(feature_names)
         self._handle = BinnedDataset.from_matrix(
             mat, cfg, label=label, weight=weight, group=group,
+            init_score=init_score,
             feature_names=feature_names, categorical_feature=cat,
             reference=None if ref is None else ref._handle)
 

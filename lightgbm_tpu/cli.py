@@ -88,7 +88,7 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
     if not config.input_model:
         log.fatal("task=predict requires input_model")
     booster = lgb.Booster(model_file=config.input_model)
-    mat, _, _, _ = load_text_file(config.data, config)
+    mat, _, _, _, _ = load_text_file(config.data, config)
     preds = booster.predict(
         mat, raw_score=config.predict_raw_score,
         pred_leaf=config.predict_leaf_index,
@@ -128,7 +128,7 @@ def run_refit(config: Config, params: Dict[str, str]) -> None:
         log.fatal("task=refit requires input_model")
     booster = lgb.Booster(model_file=config.input_model,
                           params=dict(params))
-    mat, label, weight, group = load_text_file(config.data, config)
+    mat, label, weight, group, _ = load_text_file(config.data, config)
     new_booster = booster.refit(mat, label, decay_rate=config.refit_decay_rate)
     new_booster.save_model(config.output_model)
     log.info("Finished refit, model saved to %s", config.output_model)

@@ -115,8 +115,9 @@ def _group_sizes_from_query_ids(qids: np.ndarray) -> np.ndarray:
 
 
 def load_text_file(path: str, config: Config):
-    """Returns (matrix, label, weight, group); matrix is dense ndarray
-    for CSV/TSV, scipy CSR for LibSVM (when scipy is available)."""
+    """Returns (matrix, label, weight, group, init_score); matrix is
+    dense ndarray for CSV/TSV, scipy CSR for LibSVM (when scipy is
+    available)."""
     with open(path) as fh:
         head = [fh.readline() for _ in range(3)]
     fmt = _detect_format(head)
@@ -176,7 +177,17 @@ def load_text_file(path: str, config: Config):
     wpath = path + ".weight"
     if os.path.exists(wpath):
         weight = np.loadtxt(wpath, dtype=np.float64).reshape(-1)
-    return mat, label, weight, group
+    # initial scores: "<data>.init" (or the initscore_filename override,
+    # reference config "initscore_filename"), one row per data row, one
+    # column per class (reference metadata.cpp:389-430 LoadInitialScore;
+    # class-major flattening like Metadata::init_score_)
+    init_score = None
+    ipath = config.initscore_filename or (path + ".init")
+    if os.path.exists(ipath):
+        isc = np.loadtxt(ipath, dtype=np.float64, ndmin=2)
+        init_score = isc.T.reshape(-1)  # [num_class * n], class-major
+        log.info("Loading initial scores...")
+    return mat, label, weight, group, init_score
 
 
 def _parse_delimited_fallback(path: str, delim: str, skip: int) -> np.ndarray:

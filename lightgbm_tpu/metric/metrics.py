@@ -268,7 +268,15 @@ class MultiErrorMetric(MultiLoglossMetric):
 
 
 class AucMuMetric(Metric):
-    """Multiclass pairwise AUC (reference multiclass_metric.hpp auc_mu)."""
+    """Multiclass pairwise AUC (reference multiclass_metric.hpp:183
+    AucMuMetric, the AUC-mu of Kleiman & Page 2019).
+
+    Each class pair (i, j) is scored by its distance from the separating
+    hyperplane v = W[i] - W[j] applied to the RAW class margins, where W
+    is the ``auc_mu_weights`` partition-loss matrix (default: 1 - I);
+    the pair AUC is P(dist_i > dist_j) with ties at half credit, and
+    the result averages all K(K-1)/2 pairs. Like the reference, sample
+    weights do not enter (counts only)."""
     name = "auc_mu"
     bigger_is_better = True
 
@@ -277,36 +285,48 @@ class AucMuMetric(Metric):
         nc = self.config.num_class
         if s.ndim == 1:
             s = s.reshape(nc, -1)
-        s = s.T  # [N, C]
+        # s: [C, N] class-major, the reference's score buffer layout
         lab = self.label.astype(np.int64)
-        w = self.weights if self.weights is not None else np.ones(len(lab))
-        aucs = []
+        W = np.asarray(self.config.auc_mu_weights, dtype=np.float64)
+        if W.size == nc * nc:
+            W = W.reshape(nc, nc)
+        elif W.size == 0:
+            W = 1.0 - np.eye(nc)
+        else:
+            # reference multiclass_metric.hpp errors on a wrong-sized
+            # auc_mu_weights list rather than silently ignoring it
+            raise ValueError(
+                f"auc_mu_weights must have num_class^2 = {nc * nc} "
+                f"entries, got {W.size}")
+        total = 0.0
+        pairs = 0
         for i in range(nc):
+            mi = lab == i
+            ni = int(mi.sum())
             for j in range(i + 1, nc):
-                mask = (lab == i) | (lab == j)
-                if not mask.any():
+                pairs += 1
+                mj = lab == j
+                nj = int(mj.sum())
+                if ni == 0 or nj == 0:
                     continue
-                # rank by score difference (class i vs j)
-                d = s[mask, i] - s[mask, j]
-                yy = (lab[mask] == i).astype(np.float64)
-                ww = w[mask]
-                order = np.argsort(-d, kind="stable")
-                yy, ww, dd = yy[order], ww[order], d[order]
-                pos = yy * ww
-                neg = (1 - yy) * ww
-                tn = neg.sum()
-                tp = pos.sum()
-                # tie-aware: group equal scores into blocks
-                starts = np.concatenate([[True], dd[1:] != dd[:-1]])
+                v = W[i] - W[j]
+                t1 = v[i] - v[j]
+                d = t1 * (v @ s)                       # [N] distances
+                comb = np.concatenate([d[mi], d[mj]])
+                order = np.argsort(comb, kind="stable")
+                sc = comb[order]
+                # average ranks over tie blocks: rank-sum AUC equals
+                # P(d_i > d_j) + 0.5 * P(d_i == d_j), the reference's
+                # half-credit tie rule
+                starts = np.concatenate([[True], sc[1:] != sc[:-1]])
                 blk = np.cumsum(starts) - 1
-                nb = blk[-1] + 1 if len(blk) else 0
-                bp = np.bincount(blk, weights=pos, minlength=nb)
-                bn = np.bincount(blk, weights=neg, minlength=nb)
-                cum_after = tn - np.cumsum(bn)
-                if tp > 0 and tn > 0:
-                    aucs.append(float(np.sum(bp * (cum_after + 0.5 * bn))
-                                      / (tp * tn)))
-        return [(self.name, float(np.mean(aucs)) if aucs else 1.0)]
+                counts = np.bincount(blk)
+                avg_rank = np.cumsum(counts) - (counts - 1) / 2.0
+                ranks = np.empty(len(comb))
+                ranks[order] = avg_rank[blk]
+                total += ((ranks[:ni].sum() - ni * (ni + 1) / 2.0)
+                          / (ni * nj))
+        return [(self.name, total / pairs if pairs else 1.0)]
 
 
 # --- cross entropy (xentropy_metric.hpp) ----------------------------------

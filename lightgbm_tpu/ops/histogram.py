@@ -230,35 +230,30 @@ def histogram_radix(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 # one-hots. The XLA version of histogram_radix materializes the one-hot
 # tensors to HBM (~2 KB/row of traffic for 28 uint8 codes, measured as
 # THE dominant cost of the fused tree step at HIGGS shape); here each
-# row block's one-hots live only in VMEM and the [C, 2FcBh, FcBl]
-# accumulator is flushed once. This is the direct analogue of the
-# reference GPU kernel's local-memory accumulation
+# row block's one-hots live only in VMEM and the [CS, CC, 2FcBh, FcBl]
+# accumulator is flushed once per super-chunk. This is the direct
+# analogue of the reference GPU kernel's local-memory accumulation
 # (src/treelearner/ocl/histogram256.cl:317), mapped to MXU matmuls
 # instead of local atomics.
+#
+# Feature chunks ride the pallas GRID, not the kernel body: the grid is
+# (CS super-chunks, nblk row blocks) and the body holds a CONSTANT CC
+# chunk iterations, so program size no longer scales with the feature
+# count — the round-4 wide-EFB compile blocker (581 bundle columns
+# unrolled 73 chunks in the body and exceeded 70 min of lowering; see
+# docs/SPARSE_SCALE.md). Grid order matters: row blocks are the INNER
+# (fastest) dimension so each super-chunk's accumulator block stays
+# VMEM-resident across its whole row sweep.
 # ---------------------------------------------------------------------------
 
 
-def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, C, Fc,
-                         Bh, Bl, bl_bits, dtype):
-    from jax.experimental import pallas as pl
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
-            else jax.lax.Precision.DEFAULT)
-    ct = codes_t_ref[...].astype(jnp.int32)       # [C*Fc, Rb]
-    g_t = gh_t_ref[0:1, :].astype(dtype)          # [1, Rb]
-    h_t = gh_t_ref[1:2, :].astype(dtype)
-    lo_t = (ct & (Bl - 1)).astype(dtype)
-    hi_t = (ct >> bl_bits).astype(dtype)
-
-    # Everything lives lane-major [*, Rb] (rows on lanes) and the main
-    # products are NT matmuls — no Mosaic transposes, no reshapes
-    # (Mosaic rejects last-two-dim reshapes). The per-feature code
-    # value is spread across its B slots by a constant 0/1 expansion
-    # matmul and compared against a slot iota to form the one-hots.
+def _chunk_onehot_consts(Fc, Bh, Bl, dtype):
+    """Loop-invariant expansion matrices + slot iotas for the one-hot
+    build: the per-feature code value is spread across its B slots by a
+    constant 0/1 expansion matmul and compared against a slot iota.
+    Everything lives lane-major [*, Rb] (rows on lanes) so the main
+    products are NT matmuls — no Mosaic transposes, no last-two-dim
+    reshapes (Mosaic rejects those)."""
     fcl, fch = Fc * Bl, Fc * Bh
     ex_lo = (jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 0) // Bl ==
              jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 1)).astype(dtype)
@@ -268,8 +263,19 @@ def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, C, Fc,
              jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 1)).astype(dtype)
     slot_hi = (jax.lax.broadcasted_iota(
         jnp.int32, (fch, 1), 0) % Bh).astype(jnp.float32)
+    return ex_lo, slot_lo, ex_hi, slot_hi
 
-    for c in range(C):
+
+def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype):
+    """Accumulate CC feature chunks of ``ct`` [CC*Fc, Rb] into
+    ``out_ref`` [1, CC, 2*Fc*Bh, Fc*Bl] (one super-chunk's block)."""
+    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    lo_t = (ct & (Bl - 1)).astype(dtype)
+    hi_t = (ct >> bl_bits).astype(dtype)
+    fcl, fch = Fc * Bl, Fc * Bh
+    ex_lo, slot_lo, ex_hi, slot_hi = _chunk_onehot_consts(Fc, Bh, Bl, dtype)
+    for c in range(CC):
         lo_c = lo_t[c * Fc:(c + 1) * Fc, :]       # [Fc, Rb]
         hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
         mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
@@ -284,8 +290,23 @@ def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, C, Fc,
         ph = jax.lax.dot_general(
             ah, mlo_t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
-        out_ref[c, 0:fch, :] += pg
-        out_ref[c, fch:2 * fch, :] += ph
+        out_ref[0, c, 0:fch, :] += pg
+        out_ref[0, c, fch:2 * fch, :] += ph
+
+
+def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, CC, Fc,
+                         Bh, Bl, bl_bits, dtype):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    ct = codes_t_ref[...].astype(jnp.int32)       # [CC*Fc, Rb]
+    g_t = gh_t_ref[0:1, :].astype(dtype)          # [1, Rb]
+    h_t = gh_t_ref[1:2, :].astype(dtype)
+    _accum_chunks(ct, g_t, h_t, out_ref, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
+                  bl_bits=bl_bits, dtype=dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "dtype",
@@ -306,10 +327,16 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
     Fc = max(1, 128 // Bl)
+    # super-chunk = the feature rows of one grid step, tile-aligned on
+    # the sublane dim (u8 tiles are 32 sublanes, i32 tiles 8)
+    use_u8 = num_bins <= 256
+    SPf = max(32 if use_u8 else 8, Fc)
+    CC = SPf // Fc
     C = -(-f // Fc)
-    Fp = C * Fc
+    CS = -(-C // CC)
+    Fp = CS * SPf
 
-    b = bins.astype(jnp.uint8) if num_bins <= 256 else bins.astype(jnp.int32)
+    b = bins.astype(jnp.uint8) if use_u8 else bins.astype(jnp.int32)
     if Fp > f:
         b = jnp.pad(b, ((0, 0), (0, Fp - f)), constant_values=0)
     nblk = max(1, -(-r // rows_per_block))
@@ -321,22 +348,22 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         gh_t = jnp.pad(gh_t, ((0, 0), (0, pad_r)))
 
     out = pl.pallas_call(
-        functools.partial(_radix_pallas_kernel, C=C, Fc=Fc, Bh=Bh, Bl=Bl,
+        functools.partial(_radix_pallas_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
                           bl_bits=bl_bits, dtype=dtype),
-        grid=(nblk,),
+        grid=(CS, nblk),
         in_specs=[
-            pl.BlockSpec((Fp, rows_per_block), lambda i: (0, i)),
-            pl.BlockSpec((2, rows_per_block), lambda i: (0, i)),
+            pl.BlockSpec((SPf, rows_per_block), lambda s, i: (s, i)),
+            pl.BlockSpec((2, rows_per_block), lambda s, i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((C, 2 * Fc * Bh, Fc * Bl),
-                               lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((C, 2 * Fc * Bh, Fc * Bl),
+        out_specs=pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
+                               lambda s, i: (s, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
                                        jnp.float32),
         interpret=interpret,
     )(b.T, gh_t)
 
     # extract diagonal f1 == f2 blocks (same layout as histogram_radix)
-    h_all = out.reshape(C, 2, Fc, Bh, Fc, Bl)
+    h_all = out.reshape(CS * CC, 2, Fc, Bh, Fc, Bl)
     idx = jnp.arange(Fc)
     hd = h_all[:, :, idx, :, idx, :]          # [Fc, C, 2, Bh, Bl]
     hd = jnp.transpose(hd, (1, 0, 3, 4, 2))   # [C, Fc, Bh, Bl, 2]
@@ -356,79 +383,67 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def _radix_planar_kernel(scal, data_ref, out_ref, *, C, Fc, Bh, Bl,
-                         bl_bits, dtype, code_bits, grad_plane, Rb):
+def planar_grid_dims(num_bins: int, code_bits: int, num_cols: int):
+    """Static grid geometry of the planar histogram kernel.
+
+    Returns (Fc, SP, CC, CS): Fc features per matmul chunk, SP planes
+    per super-chunk (the sublane extent of one grid step's code block, a
+    multiple of 8), CC chunks per super-chunk (the CONSTANT body unroll),
+    CS super-chunks (grid dimension 0). The planar path is viable iff
+    CS * SP <= layout.num_planes (callers guard on this)."""
+    _, bl_bits = _radix_dims(num_bins)
+    Bl = 1 << bl_bits
+    Fc = max(1, 128 // Bl)
+    # chunks must cover whole planes: Fc*code_bits multiple of 32
+    while (Fc * code_bits) % 32:
+        Fc *= 2
+    k = 32 // code_bits                 # codes per plane
+    ppc = Fc // k                       # planes per chunk (power of 2)
+    SP = max(8, ppc)
+    CC = SP // ppc
+    C = -(-num_cols // Fc)
+    CS = -(-C // CC)
+    return Fc, SP, CC, CS
+
+
+def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
+                         Bl, bl_bits, dtype, code_bits, gh_off, Rb, SP):
     from jax.experimental import pallas as pl
 
-    @pl.when(pl.program_id(0) == 0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
-
-    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
-            else jax.lax.Precision.DEFAULT)
-    i = pl.program_id(0)
 
     # blocks past the leaf range contribute nothing: skip their compute
     # entirely (their index_map is pinned to the last active block, so
     # the pipeline does not even refetch them)
     @pl.when(i <= scal[3])
     def _active():
-        x = data_ref[...]                          # [P, Rb] i32
+        x = codes_ref[...]                         # [SP, Rb] i32
         off, count = scal[1], scal[2]
         pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
         valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
 
         gh = jax.lax.bitcast_convert_type(
-            x[grad_plane:grad_plane + 2, :], jnp.float32)
+            gh_ref[gh_off:gh_off + 2, :], jnp.float32)
         g_t = (gh[0:1, :] * valid).astype(dtype)
         h_t = (gh[1:2, :] * valid).astype(dtype)
 
-        # unpack feature code rows from the packed planes: k codes per
-        # plane, feature f = plane*k + j at bit j*code_bits
-        # (ops/plane.py little-endian packing; 4-bit = IS_4BIT analogue)
+        # unpack this super-chunk's feature code rows from its packed
+        # planes: k codes per plane, feature f = plane*k + j at bit
+        # j*code_bits (ops/plane.py little-endian packing; 4-bit =
+        # IS_4BIT analogue)
         k = 32 // code_bits
         mask = (1 << code_bits) - 1
-        Fp = C * Fc
-        npl = Fp // k
-        planes = x[0:npl, :]
-        e = jnp.broadcast_to(planes[:, None, :], (npl, k, Rb)) \
-            .reshape(npl * k, Rb)
-        sh = (jax.lax.broadcasted_iota(jnp.int32, (Fp, 1), 0) % k) \
+        Fsp = SP * k                               # = CC * Fc
+        e = jnp.broadcast_to(x[:, None, :], (SP, k, Rb)).reshape(Fsp, Rb)
+        sh = (jax.lax.broadcasted_iota(jnp.int32, (Fsp, 1), 0) % k) \
             * code_bits
-        ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fp, Rb]
-
-        lo_t = (ct & (Bl - 1)).astype(dtype)
-        hi_t = (ct >> bl_bits).astype(dtype)
-
-        fcl, fch = Fc * Bl, Fc * Bh
-        ex_lo = (jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 0) // Bl ==
-                 jax.lax.broadcasted_iota(jnp.int32, (fcl, Fc), 1)) \
-            .astype(dtype)
-        slot_lo = (jax.lax.broadcasted_iota(
-            jnp.int32, (fcl, 1), 0) % Bl).astype(jnp.float32)
-        ex_hi = (jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 0) // Bh ==
-                 jax.lax.broadcasted_iota(jnp.int32, (fch, Fc), 1)) \
-            .astype(dtype)
-        slot_hi = (jax.lax.broadcasted_iota(
-            jnp.int32, (fch, 1), 0) % Bh).astype(jnp.float32)
-
-        for c in range(C):
-            lo_c = lo_t[c * Fc:(c + 1) * Fc, :]
-            hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
-            mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
-                     == slot_lo).astype(dtype)
-            mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
-                     == slot_hi)
-            ag = mhi_t.astype(dtype) * g_t
-            ah = mhi_t.astype(dtype) * h_t
-            pg = jax.lax.dot_general(
-                ag, mlo_t, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=prec)
-            ph = jax.lax.dot_general(
-                ah, mlo_t, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=prec)
-            out_ref[c, 0:fch, :] += pg
-            out_ref[c, fch:2 * fch, :] += ph
+        ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fsp, Rb]
+        _accum_chunks(ct, g_t, h_t, out_ref, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
+                      bl_bits=bl_bits, dtype=dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
@@ -443,7 +458,9 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     """Leaf-window histogram straight off the planar state.
 
     data: [P, R] int32 planar training rows; the window is the lane
-    range [start, start+count), read as `cap//Rb + 1` aligned blocks.
+    range [start, start+count), read as `cap//Rb + 1` aligned blocks per
+    super-chunk of 8 code planes (grid=(CS, nblk) — feature chunks ride
+    the grid so the program no longer scales with the column count).
     Returns [num_cols, num_bins, 2] f32.
     """
     from jax.experimental import pallas as pl
@@ -453,11 +470,15 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     Rb = rows_per_block if rows_per_block is not None else PLANAR_RB
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
-    Fc = max(1, 128 // Bl)
-    # chunks must cover whole planes: Fc*code_bits multiple of 32
-    while (Fc * code_bits) % 32:
-        Fc *= 2
-    C = -(-num_cols // Fc)
+    Fc, SP, CC, CS = planar_grid_dims(num_bins, code_bits, num_cols)
+    if CS * SP > P:
+        raise ValueError(
+            f"planar histogram needs {CS * SP} readable planes, state has "
+            f"{P} — caller must fall back to the row-major path")
+    # grad+hess must sit inside one aligned (8, Rb) block
+    # (plane.make_layout guarantees grad % 8 <= 6)
+    gh_blk, gh_off = grad_plane // 8, grad_plane % 8
+    assert gh_off <= 6, grad_plane
     nblk = cap // Rb + 1
     assert nblk * Rb <= R
 
@@ -470,30 +491,42 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nblk,),
-        in_specs=[pl.BlockSpec(
-            (P, Rb),
-            lambda i, scal: (0, scal[0] + jnp.minimum(i, scal[3])))],
-        out_specs=pl.BlockSpec((C, 2 * Fc * Bh, Fc * Bl),
-                               lambda i, scal: (0, 0, 0)),
+        grid=(CS, nblk),
+        in_specs=[
+            pl.BlockSpec(
+                (SP, Rb),
+                lambda s, i, scal: (s, scal[0] + jnp.minimum(i, scal[3]))),
+            # the same gh block is re-fetched once per super-chunk per
+            # row block (index independent of s but s is the outer grid
+            # dim). Deliberate: the kernel is one-hot-VPU-bound (~16 us
+            # compute vs ~80 ns DMA per step at Rb=1024), and the
+            # alternative — a pre-sliced [2, R] gh operand — costs an
+            # XLA copy of two full planes per histogram call
+            pl.BlockSpec(
+                (8, Rb),
+                lambda s, i, scal: (gh_blk,
+                                    scal[0] + jnp.minimum(i, scal[3]))),
+        ],
+        out_specs=pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
+                               lambda s, i, scal: (s, 0, 0, 0)),
         scratch_shapes=[],
     )
     out = pl.pallas_call(
-        functools.partial(_radix_planar_kernel, C=C, Fc=Fc, Bh=Bh, Bl=Bl,
+        functools.partial(_radix_planar_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
                           bl_bits=bl_bits, dtype=dtype,
-                          code_bits=code_bits, grad_plane=grad_plane,
-                          Rb=Rb),
+                          code_bits=code_bits, gh_off=gh_off,
+                          Rb=Rb, SP=SP),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((C, 2 * Fc * Bh, Fc * Bl),
+        out_shape=jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
                                        jnp.float32),
         interpret=interpret,
-    )(scal, data)
+    )(scal, data, data)
 
-    h_all = out.reshape(C, 2, Fc, Bh, Fc, Bl)
+    h_all = out.reshape(CS * CC, 2, Fc, Bh, Fc, Bl)
     idx = jnp.arange(Fc)
     hd = h_all[:, :, idx, :, idx, :]
     hd = jnp.transpose(hd, (1, 0, 3, 4, 2))
-    hd = hd.reshape(C * Fc, Bh * Bl, 2)[:num_cols, :num_bins, :]
+    hd = hd.reshape(CS * CC * Fc, Bh * Bl, 2)[:num_cols, :num_bins, :]
     return hd
 
 

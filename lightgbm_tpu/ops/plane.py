@@ -48,6 +48,24 @@ import jax.numpy as jnp
 LANE = 128          # TPU lane count; DMA offsets/sizes must align to it
 import os as _os
 DEF_TILE = int(_os.environ.get("LGBM_TPU_TILE", 4096))
+# scoped-VMEM budget for the partition kernels' staging buffers (the
+# hardware limit is 16 MB; leave headroom for the pipeline's own
+# double-buffered block)
+PART_VMEM_BUDGET = int(_os.environ.get("LGBM_TPU_PART_VMEM", 13_000_000))
+
+
+def partition_vmem_bytes(layout: "PlaneLayout", method: str = "pallas2") -> int:
+    """Scoped-VMEM bytes a partition kernel holds at once: the staging/
+    carry/output buffers all span the full plane count P, so wide-EFB
+    states (hundreds of code planes) can exceed the 16 MB scoped limit
+    at the default 4096-lane tile. Widths are CALIBRATED to compiler-
+    reported scoped allocations (Mosaic multi-buffers the pipeline
+    block on top of the declared scratch): at P=152, S=4096 the
+    compiler reports 21.97 MB for v2 and 18.12 MB for v1 — ~8.8*S and
+    ~7.3*S lane-widths; a margin is added on both."""
+    P, S = layout.num_planes, layout.tile
+    width = 16 * S if method == "pallas2" else 8 * S
+    return P * width * 4
 
 
 class PlaneLayout(NamedTuple):
@@ -75,6 +93,11 @@ def make_layout(num_cols: int, code_bits: int, n: int,
     assert code_bits in (4, 8, 16)
     cp = -(-num_cols * code_bits // 32)
     p = cp
+    if p % 8 == 7:
+        # keep grad+hess inside ONE aligned 8-plane block: the planar
+        # histogram kernel fetches them as an (8, Rb) tile-aligned
+        # BlockSpec (ops/histogram.py), which requires grad % 8 <= 6
+        p += 1
     grad, hess = p, p + 1
     p += 2
     rowid = p
@@ -146,6 +169,9 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
         return jnp.pad(x, (0, R - x.shape[0])) if x.shape[0] < R else x
 
     rows = [codes_planes]
+    gap = layout.grad - layout.code_planes
+    if gap:
+        rows.append(jnp.zeros((gap, R), jnp.int32))
     extra = [f32_as_i32(lane_pad_f(grad))[None], f32_as_i32(lane_pad_f(hess))[None]]
     if rowid is None:
         rowid = jnp.arange(n, dtype=jnp.int32)
@@ -158,7 +184,7 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
             v = val if val is not None else jnp.zeros(n, jnp.float32)
             extra.append(f32_as_i32(lane_pad_f(v))[None])
     rows.append(jnp.concatenate(extra, axis=0))
-    pad = layout.num_planes - layout.code_planes - len(extra)
+    pad = layout.num_planes - layout.grad - len(extra)
     if pad:
         rows.append(jnp.zeros((pad, R), jnp.int32))
     return jnp.concatenate(rows, axis=0)

@@ -247,23 +247,42 @@ class FusedSerialGrower:
                    and objective.persistent_aux() is not None
                    and objective.num_tree_per_iteration == 1)
         has_w = persist and objective.persistent_aux()[1] is not None
-        self.layout = plane.make_layout(
-            self._num_cols, self._code_bits, n,
-            with_label=persist, with_score=persist, with_weight=has_w)
+
+        def mk_layout(tile):
+            return plane.make_layout(
+                self._num_cols, self._code_bits, n,
+                with_label=persist, with_score=persist, with_weight=has_w,
+                tile=tile)
+
+        self.layout = mk_layout(plane.DEF_TILE)
+        # scoped-VMEM budgeting: every partition staging buffer spans
+        # the full plane count P, so wide-EFB states (hundreds of code
+        # planes) overflow the 16 MB scoped VMEM at the default tile —
+        # shrink the lane tile until even the v1 kernel fits
+        while (self.layout.tile > 512
+               and plane.partition_vmem_bytes(self.layout, "pallas")
+               > plane.PART_VMEM_BUDGET):
+            t = self.layout.tile // 2
+            log.info("partition VMEM at P=%d exceeds budget: shrinking "
+                     "lane tile to %d", self.layout.num_planes, t)
+            self.layout = mk_layout(t)
         self.persistent_capable = persist
         self._codes_planes_dev = None   # built lazily
         # wide-EFB HBM budgeting: the v2 partition kernel's scratch is
         # TWO window regions (L and R streams); when the planar state
         # itself is multi-GB, v1's single-region scratch keeps
         # state+scratch at 2x instead of 3x (the Allstate shape:
-        # ~60 code planes x 13.2M lanes)
+        # ~60 code planes x 13.2M lanes). v2 also holds 3x the staging
+        # VMEM, so wide-plane states take v1 for the scoped limit too.
         if self._part_method == "pallas2":
             state_gb = (self.layout.num_planes * self.layout.num_lanes
                         * 4 / 1e9)
-            if state_gb > 2.5:
+            v2_vmem = plane.partition_vmem_bytes(self.layout, "pallas2")
+            if state_gb > 2.5 or v2_vmem > plane.PART_VMEM_BUDGET:
                 self._part_method = "pallas"
-                log.info("planar state %.1f GB: selecting the "
-                         "single-scratch partition kernel", state_gb)
+                log.info("planar state %.1f GB / v2 scratch %.1f MB: "
+                         "selecting the single-scratch partition kernel",
+                         state_gb, v2_vmem / 1e6)
 
         # histogram_pool_size (MB; <=0 unlimited — reference
         # feature_histogram.hpp:1061 HistogramPool): when the dense
@@ -408,15 +427,11 @@ class FusedSerialGrower:
         R = Ly.num_lanes
         nbins = (self.group_max_bin if self._efb_hist is not None
                  else self.max_num_bin)
-        # planar kernel unpacks C*Fc padded feature rows from the planes;
-        # ensure the padding never reads past the plane count
-        bh_bits, bl_bits = H._radix_dims(nbins)
-        fc = max(1, 128 // (1 << bl_bits))
-        while (fc * Ly.code_bits) % 32:
-            fc *= 2
-        npl = (-(-Ly.num_cols // fc)) * fc * Ly.code_bits // 32
+        # planar kernel reads CS super-chunks of SP planes off the grid;
+        # ensure the padded super-chunks never read past the plane count
+        _, sp, _, cs = H.planar_grid_dims(nbins, Ly.code_bits, Ly.num_cols)
         planar_ok = (self._hist_method is not None
-                     and npl <= Ly.num_planes)
+                     and cs * sp <= Ly.num_planes)
         dtype = (jnp.bfloat16 if self._hist_method == "radix_pallas_bf16"
                  else jnp.float32)
 

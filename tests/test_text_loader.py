@@ -14,7 +14,7 @@ def test_quoted_fields_and_na_strings(tmp_path):
     p = tmp_path / "data.csv"
     p.write_text('1,"2.5",na,4\n0,NULL,"3.25",5\n1,2.0,N/A,\n')
     cfg = Config()
-    mat, label, weight, group = load_text_file(str(p), cfg)
+    mat, label, weight, group, _ = load_text_file(str(p), cfg)
     np.testing.assert_array_equal(label, [1, 0, 1])
     assert mat.shape == (3, 3)
     np.testing.assert_allclose(mat[0], [2.5, np.nan, 4], equal_nan=True)
@@ -27,7 +27,7 @@ def test_header_and_named_columns(tmp_path):
     p.write_text("target,w,f1,f2\n1,2.0,3,4\n0,1.0,5,6\n")
     cfg = Config.from_params({"header": True, "label_column": "name:target",
                               "weight_column": "name:w"})
-    mat, label, weight, group = load_text_file(str(p), cfg)
+    mat, label, weight, group, _ = load_text_file(str(p), cfg)
     np.testing.assert_array_equal(label, [1, 0])
     np.testing.assert_array_equal(weight, [2.0, 1.0])
     np.testing.assert_array_equal(mat, [[3, 4], [5, 6]])
@@ -41,14 +41,14 @@ def test_ignore_column(tmp_path):
     p = tmp_path / "data.csv"
     p.write_text("1,10,20,30\n0,11,21,31\n")
     cfg = Config.from_params({"ignore_column": "1"})
-    mat, label, _, _ = load_text_file(str(p), cfg)
+    mat, label, _, _, _ = load_text_file(str(p), cfg)
     np.testing.assert_array_equal(mat, [[10, 30], [11, 31]])
 
 
 def test_tsv_detection(tmp_path):
     p = tmp_path / "data.tsv"
     p.write_text("1\t2.5\t3\n0\t4.5\t6\n")
-    mat, label, _, _ = load_text_file(str(p), Config())
+    mat, label, _, _, _ = load_text_file(str(p), Config())
     np.testing.assert_array_equal(label, [1, 0])
     np.testing.assert_array_equal(mat, [[2.5, 3], [4.5, 6]])
 
@@ -59,7 +59,7 @@ def test_group_column_query_ids(tmp_path):
     rows = ["1,%d,0.5" % q for q in (7, 7, 7, 9, 9, 4)]
     p.write_text("\n".join(rows) + "\n")
     cfg = Config.from_params({"group_column": "0"})
-    mat, label, _, group = load_text_file(str(p), cfg)
+    mat, label, _, group, _ = load_text_file(str(p), cfg)
     np.testing.assert_array_equal(group, [3, 2, 1])
     assert mat.shape == (6, 1)
 
@@ -68,7 +68,7 @@ def test_libsvm_sparse_output(tmp_path):
     sp = pytest.importorskip("scipy.sparse")
     p = tmp_path / "data.svm"
     p.write_text("1 0:1.5 3:2.0\n0 1:4.0\n1 0:0.5 4:1.0\n")
-    mat, label, _, _ = load_text_file(str(p), Config())
+    mat, label, _, _, _ = load_text_file(str(p), Config())
     assert sp.issparse(mat)
     assert mat.shape == (3, 5)
     assert mat[0, 3] == 2.0 and mat[2, 4] == 1.0
