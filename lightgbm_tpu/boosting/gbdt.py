@@ -14,6 +14,7 @@ program over the score array. The host drives the iteration loop.
 """
 from __future__ import annotations
 
+import functools
 import math
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -961,6 +962,37 @@ class DART(GBDT):
                     self.tree_weight[j] *= k_drop / (k_drop + cfg.learning_rate)
 
 
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_sample_device(grad, hess, seed, *, top_k: int, other_k: int):
+    """Device-side GOSS round (reference goss.hpp:111-147): top_k rows
+    by sum_c |g*h|, other_k uniform from the rest upweighted by
+    (n - top_k) / other_k, and the stable [bag | oob] permutation —
+    all without host round-trips of [C, N] arrays. The permutation is
+    built by destination ranks (two prefix sums + one scatter), not an
+    argsort: both sides keep ascending row order, exactly the host
+    path's sorted-bag/oob layout."""
+    n = grad.shape[1]
+    weight = jnp.sum(jnp.abs(grad * hess), axis=0)            # [n]
+    _, top_rows = jax.lax.top_k(weight, top_k)
+    is_top = jnp.zeros(n, jnp.bool_).at[top_rows].set(True)
+    # uniform sample WITHOUT replacement from the rest: random keys,
+    # top rows masked below every real key, take the other_k largest
+    r = jax.random.uniform(jax.random.PRNGKey(seed), (n,))
+    _, sampled = jax.lax.top_k(jnp.where(is_top, -1.0, r), other_k)
+    multiply = jnp.float32((n - top_k) / other_k)
+    grad = grad.at[:, sampled].multiply(multiply)
+    hess = hess.at[:, sampled].multiply(multiply)
+    in_bag = is_top.at[sampled].set(True)
+    # stable two-way partition of row ids by destination rank
+    bag_rank = jnp.cumsum(in_bag.astype(jnp.int32)) - 1
+    oob_rank = (top_k + other_k
+                + jnp.cumsum((~in_bag).astype(jnp.int32)) - 1)
+    dest = jnp.where(in_bag, bag_rank, oob_rank)
+    perm = jnp.zeros(n, jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return grad, hess, perm
+
+
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (reference goss.hpp:25)."""
 
@@ -980,27 +1012,12 @@ class GOSS(GBDT):
             self._perm = self._full_perm
             self.bag_data_cnt = n
             return
-        g = np.asarray(self._grad)
-        h = np.asarray(self._hess)
-        # sum_c |g*h| (reference goss.hpp:111 accumulates fabs per class)
-        weight = np.sum(np.abs(g * h), axis=0)
         top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
-        thresh_idx = np.argpartition(-weight, top_k - 1)
-        top_rows = thresh_idx[:top_k]
-        rest_rows = thresh_idx[top_k:]
-        sampled = self._bag_rng.choice(rest_rows, size=min(other_k, len(rest_rows)),
-                                       replace=False)
-        multiply = (n - top_k) / other_k
-        gm = jnp.asarray(np.float32(multiply))
-        sam = jnp.asarray(sampled.astype(np.int32))
-        self._grad = self._grad.at[:, sam].multiply(gm)
-        self._hess = self._hess.at[:, sam].multiply(gm)
-        bag = np.concatenate([top_rows, sampled])
-        bag.sort()
-        oob = np.setdiff1d(np.arange(n), bag, assume_unique=False)
-        self._perm = jnp.asarray(np.concatenate([bag, oob]).astype(np.int32))
-        self.bag_data_cnt = len(bag)
+        other_k = max(1, min(int(n * cfg.other_rate), n - top_k))
+        seed = jnp.int32(self._bag_rng.randint(1 << 31))
+        self._grad, self._hess, self._perm = _goss_sample_device(
+            self._grad, self._hess, seed, top_k=top_k, other_k=other_k)
+        self.bag_data_cnt = top_k + other_k
 
 
 class RF(GBDT):

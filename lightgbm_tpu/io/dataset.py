@@ -330,28 +330,21 @@ class BinnedDataset:
             return np.arange(sample_cnt), col
 
         # --- per-feature bin finding ---
-        if config.num_machines > 1 and not sparse_input:
+        if config.num_machines > 1:
             # distributed construction protocol: per-rank owned-feature
             # binning + mapper allgather over the mesh (reference
             # dataset_loader.cpp:917-990). Single-controller mode bins
             # over the full in-process sample, so boundaries are
-            # bit-identical to single-machine construction
+            # bit-identical to single-machine construction. Sparse
+            # samples stay CSC end-to-end (round-5: the dense-only
+            # restriction is gone — column slices come from the CSC
+            # structure inside find_bins_for_features)
             from .distributed import distributed_find_bin_mappers
             mappers = distributed_find_bin_mappers(
-                np.asarray(sample, dtype=np.float64), config, cat_set)
+                sample if sparse_input
+                else np.asarray(sample, dtype=np.float64),
+                config, cat_set)
         else:
-            if config.num_machines > 1 and sparse_input:
-                # the ownership-partition/allgather protocol consumes a
-                # dense sample; in single-controller mode the local path
-                # below produces BIT-IDENTICAL boundaries (the protocol
-                # bins each rank's owned features over the same full
-                # sample — see distributed_find_bin_mappers), so this
-                # fallback changes work placement only, never bins
-                log.warning(
-                    "num_machines=%d with sparse input: bin finding "
-                    "runs single-machine (boundaries identical to the "
-                    "distributed protocol in single-controller mode)",
-                    config.num_machines)
             mappers = cls._find_bin_mappers_local(
                 sample_col_nonzeros, total_features, sample_cnt, config,
                 cat_set)

@@ -60,10 +60,24 @@ def find_bins_for_features(sample: np.ndarray, features: Sequence[int],
 
     pre_filter defaults off because on a true multi-host shard it would
     need global stats; the single-controller driver passes the config
-    value through (its "local" sample IS the global sample)."""
+    value through (its "local" sample IS the global sample).
+
+    ``sample`` may be a scipy CSC matrix: a column's stored values are
+    exactly the dense column minus structural zeros, which the
+    |col| > kZeroThreshold filter below would drop anyway — boundaries
+    are bit-identical to the dense path (asserted by
+    tests/test_distributed_binning.py)."""
+    is_sparse = hasattr(sample, "getformat")
+    if is_sparse and sample.getformat() != "csc":
+        sample = sample.tocsc()
     out = []
     for f in features:
-        col = np.asarray(sample[:, f], dtype=np.float64)
+        if is_sparse:
+            col = np.asarray(
+                sample.data[sample.indptr[f]:sample.indptr[f + 1]],
+                dtype=np.float64)
+        else:
+            col = np.asarray(sample[:, f], dtype=np.float64)
         nonzero = col[(np.abs(col) > K_ZERO_THRESHOLD) | np.isnan(col)]
         m = BinMapper()
         mb = (config.max_bin_by_feature[f]
@@ -145,7 +159,7 @@ def construct_bin_mappers_distributed(
     """
     f_total = local_sample.shape[1]
     owned = partition_features(f_total, world)[rank]
-    total = total_sample_cnt or len(local_sample)
+    total = total_sample_cnt or int(local_sample.shape[0])
     return find_bins_for_features(local_sample, owned, config, total,
                                   cat_set, pre_filter=pre_filter)
 
@@ -191,7 +205,12 @@ def distributed_find_bin_mappers(sample: np.ndarray, config: Config,
 
     world = int(config.num_machines)
     n, f_total = sample.shape
-    full = np.asarray(sample, dtype=np.float64)
+    if hasattr(sample, "getformat"):
+        # sparse samples ride the same protocol: column slices come
+        # straight from the CSC structure, never densified
+        full = sample.tocsc()
+    else:
+        full = np.asarray(sample, dtype=np.float64)
     pairs = [construct_bin_mappers_distributed(
         full, r, world, config, cat_set, total_sample_cnt=n,
         pre_filter=config.feature_pre_filter)

@@ -31,31 +31,42 @@ from ..utils import log
 
 def _np_weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
                             alpha: float) -> float:
-    """PercentileFun / WeightedPercentileFun (reference
-    regression_objective.hpp:23-88)."""
+    """PercentileFun / WeightedPercentileFun, faithful to the reference
+    (regression_objective.hpp:18-88). Two quirks of that code are
+    mirrored deliberately rather than "fixed": the unweighted rule
+    selects DESCENDING at float_pos = (1-alpha)*cnt via ArgMaxAtK
+    (so the even-count median of [1,2,3,4] is 3, not 2.5), and the
+    weighted rule interpolates only when the next item's cumulative-
+    weight step is >= 1.0 — with threshold < cdf[pos], i.e. a negative
+    interpolation factor, exactly as the reference computes it."""
     n = len(values)
     if n == 0:
         return 0.0
+    if n <= 1:
+        return float(values[0])
     if weights is None:
-        if n <= 1:
-            return float(values[0])
-        order = np.argsort(values, kind="stable")
-        pos = alpha * (n - 1)
-        lo = int(np.floor(pos))
-        hi = min(lo + 1, n - 1)
-        frac = pos - lo
-        return float(values[order[lo]] * (1 - frac) + values[order[hi]] * frac)
+        float_pos = (1.0 - alpha) * n
+        pos = int(float_pos)
+        if pos < 1:
+            return float(np.max(values))
+        if pos >= n:
+            return float(np.min(values))
+        bias = float_pos - pos
+        d = np.sort(values)[::-1]            # descending, like ArgMaxAtK
+        return float(d[pos - 1] - (d[pos - 1] - d[pos]) * bias)
     order = np.argsort(values, kind="stable")
     sv = values[order]
-    sw = weights[order].astype(np.float64)
-    # reference WeightedPercentileFun: find first index where the
-    # cumulative weight exceeds alpha * total
-    cum = np.cumsum(sw) - sw / 2.0
-    total = sw.sum()
-    threshold = alpha * total
-    idx = int(np.searchsorted(cum, threshold, side="left"))
-    idx = min(idx, n - 1)
-    return float(sv[idx])
+    cdf = np.cumsum(weights[order].astype(np.float64))
+    threshold = alpha * cdf[-1]
+    pos = int(np.searchsorted(cdf, threshold, side="right"))  # upper_bound
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(sv[pos])
+    v1, v2 = float(sv[pos - 1]), float(sv[pos])
+    if cdf[pos + 1] - cdf[pos] >= 1.0:
+        return (threshold - cdf[pos]) / (cdf[pos + 1] - cdf[pos]) \
+            * (v2 - v1) + v1
+    return float(v2)
 
 
 class ObjectiveFunction:
@@ -99,6 +110,15 @@ class ObjectiveFunction:
 
     def persistent_grads(self, score, label, weight):
         raise NotImplementedError
+
+    def persistent_renew_spec(self):
+        """(alpha, weighted) for the in-program leaf refit of
+        renew-tree-output objectives (treelearner/fused.py
+        _renew_leaf_outputs), or None when the objective has no leaf
+        renewal. ``weighted`` must match whether ``persistent_aux``
+        carries a weight plane — the refit reads it as the percentile
+        weights (reference regression_objective.hpp RenewTreeOutput)."""
+        return None
 
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
@@ -172,6 +192,16 @@ class RegressionL1(RegressionL2):
         g = jnp.sign(diff)
         h = jnp.ones_like(g)
         return self._apply_weights(g, h)
+
+    def persistent_grads(self, score, label, weight):
+        g = jnp.sign(score - label)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def persistent_renew_spec(self):
+        return 0.5, getattr(self, "weights", None) is not None
 
     def boost_from_score(self, class_id):
         return _np_weighted_percentile(self.label, self.weights, 0.5)
@@ -294,6 +324,17 @@ class RegressionQuantile(RegressionL2):
         h = jnp.ones_like(g)
         return self._apply_weights(g, h)
 
+    def persistent_grads(self, score, label, weight):
+        delta = score - label
+        g = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def persistent_renew_spec(self):
+        return self.alpha, getattr(self, "weights", None) is not None
+
     def boost_from_score(self, class_id):
         return _np_weighted_percentile(self.label, self.weights, self.alpha)
 
@@ -325,6 +366,21 @@ class RegressionMAPE(RegressionL1):
         g = jnp.sign(diff) * self._label_weight_dev
         h = jnp.ones_like(g) if self._weights_dev is None else self._weights_dev
         return g, h
+
+    def persistent_aux(self):
+        # the weight plane carries label_weight = w / max(1, |label|):
+        # it is both the gradient scale and the renewal percentile
+        # weight (reference RegressionMAPELOSS::RenewTreeOutput)
+        return self._label_dev, self._label_weight_dev
+
+    def persistent_grads(self, score, label, weight):
+        g = jnp.sign(score - label) * weight
+        # sample weight = label_weight * max(1, |label|)
+        h = weight * jnp.maximum(1.0, jnp.abs(label))
+        return g, h
+
+    def persistent_renew_spec(self):
+        return 0.5, True
 
     def boost_from_score(self, class_id):
         return _np_weighted_percentile(self.label, self.label_weight, 0.5)

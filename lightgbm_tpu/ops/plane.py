@@ -126,9 +126,10 @@ def i32_as_f32(x):
     return jax.lax.bitcast_convert_type(x, jnp.float32)
 
 
-def build_codes_planes(codes: jax.Array, layout: PlaneLayout) -> jax.Array:
-    """[n, G] u8/u16 bin codes -> [code_planes, R] i32 (little-endian
-    packing: column j occupies bits [j*bits % 32, ...) of plane
+def _pack_codes(codes: jax.Array, layout: PlaneLayout,
+                lanes: int) -> jax.Array:
+    """[n, G] u8/u16 bin codes -> [code_planes, lanes] i32 (little-
+    endian packing: column j occupies bits [j*bits % 32, ...) of plane
     j*bits // 32; 4-bit mode packs two columns per byte)."""
     n, g = codes.shape
     bits = layout.code_bits
@@ -145,12 +146,51 @@ def build_codes_planes(codes: jax.Array, layout: PlaneLayout) -> jax.Array:
     width = layout.code_planes * 4
     if b.shape[1] < width:
         b = jnp.pad(b, ((0, 0), (0, width - b.shape[1])))
-    if n < layout.num_lanes:
-        b = jnp.pad(b, ((0, layout.num_lanes - n), (0, 0)))
-    # [R, C, 4] -> bitcast i32 [R, C] -> transpose [C, R]
+    if n < lanes:
+        b = jnp.pad(b, ((0, lanes - n), (0, 0)))
+    # [lanes, C, 4] -> bitcast i32 [lanes, C] -> transpose [C, lanes]
     planes = jax.lax.bitcast_convert_type(
-        b.reshape(layout.num_lanes, layout.code_planes, 4), jnp.int32)
+        b.reshape(lanes, layout.code_planes, 4), jnp.int32)
     return planes.T
+
+
+def build_codes_planes(codes: jax.Array, layout: PlaneLayout) -> jax.Array:
+    """[n, G] u8/u16 bin codes -> [code_planes, R] i32."""
+    return _pack_codes(codes, layout, layout.num_lanes)
+
+
+def build_codes_planes_chunked(codes_host, layout: PlaneLayout,
+                               row_chunk: int = 1 << 21) -> jax.Array:
+    """Pack HOST-resident bin codes into the planar layout in row
+    chunks, so the transient row-major device upload is bounded by
+    ``row_chunk * G`` bytes instead of the full [N, G] matrix — at the
+    Allstate shape (13.2M x 581 bundles) a one-shot upload is 7.7 GB
+    sitting next to the 4.3 GB planar state and OOMs HBM before the
+    async free lands."""
+    n = codes_host.shape[0]
+    if n <= row_chunk:
+        return build_codes_planes(jnp.asarray(codes_host), layout)
+    out = jnp.zeros((layout.code_planes, layout.num_lanes), jnp.int32)
+    pack = jax.jit(functools.partial(_pack_codes, layout=layout,
+                                     lanes=row_chunk),
+                   static_argnames=())
+    upd = jax.jit(lambda o, p, pos: jax.lax.dynamic_update_slice(
+        o, p, (0, pos)), donate_argnums=0)
+    pos = 0
+    while pos < n:
+        c = min(row_chunk, n - pos)
+        # dynamic_update_slice clamps out-of-range starts, so the final
+        # window is shifted LEFT to end inside the lane buffer —
+        # re-writing a prefix of already-written rows with identical
+        # values rather than letting the clamp misplace the chunk
+        start = min(pos, layout.num_lanes - row_chunk)
+        take = min(start + row_chunk, n) - start
+        chunk = np.asarray(codes_host[start:start + take])
+        if take < row_chunk:
+            chunk = np.pad(chunk, ((0, row_chunk - take), (0, 0)))
+        out = upd(out, pack(jnp.asarray(chunk)), jnp.int32(start))
+        pos += c
+    return out
 
 
 def build_data(layout: PlaneLayout, codes_planes: jax.Array,

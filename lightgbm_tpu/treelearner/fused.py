@@ -62,6 +62,19 @@ from ..utils import log
 NEG_INF = jnp.float32(-jnp.inf)
 
 
+def bag_active(config: Config) -> bool:
+    """Whether row sampling re-permutes rows away from score order —
+    shared by fused_reject_reason and the grower's
+    _score_from_partition so the two can never disagree (a renew
+    objective accepted here but non-persistent there would silently
+    skip its leaf refit)."""
+    return ((config.bagging_freq > 0
+             and (config.bagging_fraction < 1.0
+                  or config.pos_bagging_fraction < 1.0
+                  or config.neg_bagging_fraction < 1.0))
+            or config.boosting in ("goss", "rf"))
+
+
 def fused_reject_reason(config: Config, dataset: BinnedDataset,
                         objective) -> Optional[str]:
     """Why a config cannot run the fused single-dispatch path (None =
@@ -101,7 +114,14 @@ def fused_reject_reason(config: Config, dataset: BinnedDataset,
         return ("monotone_constraints_method=intermediate or "
                 "monotone_penalty > 0")
     if objective is not None and objective.is_renew_tree_output:
-        return f"objective={objective.name} (renew-tree-output leaf refit)"
+        # the leaf refit runs in-program via _renew_leaf_outputs, which
+        # needs the persistent path's label/score planes — reject
+        # configs that would take the per-tree fused path instead
+        # (bagging/GOSS/RF/DART re-permute rows away from score order)
+        if (objective.persistent_renew_spec() is None
+                or config.boosting != "gbdt" or bag_active(config)):
+            return (f"objective={objective.name} (renew-tree-output leaf "
+                    "refit outside the persistent path)")
     if dataset.num_features == 0:
         return "dataset has no usable features"
     return None
@@ -342,13 +362,7 @@ class FusedSerialGrower:
         # when every scored row is in-bag (no bagging/GOSS/RF); with
         # bagging the out-of-bag rows are never partitioned and the
         # fallback is the tree re-traversal
-        bag_active = (
-            (config.bagging_freq > 0
-             and (config.bagging_fraction < 1.0
-                  or config.pos_bagging_fraction < 1.0
-                  or config.neg_bagging_fraction < 1.0))
-            or config.boosting in ("goss", "rf"))
-        self._score_from_partition = not bag_active
+        self._score_from_partition = not bag_active(config)
 
         # multi-chip: name of the mesh axis to psum histograms/counts
         # over (set by the data-parallel wrapper; None on one chip)
@@ -379,12 +393,21 @@ class FusedSerialGrower:
     # ------------------------------------------------------------------
     def codes_planes(self) -> jax.Array:
         if self._codes_planes_dev is None:
-            # transient row-major upload when the device copy is not
-            # already resident (persistent path never needs it again)
-            src = self._bins_dev if self._bins_dev is not None \
-                else jnp.asarray(self.dataset.bins)
-            self._codes_planes_dev = plane.build_codes_planes(
-                src, self.layout)
+            if self._bins_dev is not None:
+                self._codes_planes_dev = plane.build_codes_planes(
+                    self._bins_dev, self.layout)
+            elif self.dataset.bins.nbytes > (1 << 31):
+                # chunked host->device packing: a one-shot row-major
+                # upload at wide-EFB scale (13.2M x 581 = 7.7 GB u8)
+                # OOMs HBM next to the planar state before the async
+                # free lands
+                self._codes_planes_dev = plane.build_codes_planes_chunked(
+                    self.dataset.bins, self.layout)
+            else:
+                # transient row-major upload; the persistent path never
+                # needs the row-major copy again
+                self._codes_planes_dev = plane.build_codes_planes(
+                    jnp.asarray(self.dataset.bins), self.layout)
         return self._codes_planes_dev
 
     def _switch_by_cap(self, count, branches_of_cap, *args):
@@ -919,6 +942,176 @@ class FusedSerialGrower:
         steps = (pos[:, None] >= sorted_starts[None, :]).astype(jnp.float32)
         return jnp.sum(steps * d[None, :], axis=1)
 
+    # -- in-program leaf renewal (renew-tree-output objectives) --------
+    def _renew_leaf_outputs(self, st: FusedTreeState, n, alpha: float,
+                            weighted: bool):
+        """Per-leaf weighted percentile of residuals straight off the
+        leaf-ordered planar state — the device form of
+        RegressionL1loss::RenewTreeOutput and the Percentile/
+        WeightedPercentileFun selection (reference
+        regression_objective.hpp:23-88,249).
+
+        No sorts and no [N] gathers: residuals map to a monotone uint32
+        key (sign-flipped float bits) and each leaf's order statistic is
+        found by a 32-step bisection over key space. The per-step
+        per-leaf counts come from one [R] compare + cumsum, read back at
+        the window boundaries — every step is a fused VPU pass, and the
+        counts psum across shards so the refit is exact under the
+        sharded data-parallel learner.
+
+        Tie semantics (weighted mode): the reference walks the stable
+        sort order and takes the first item whose cumulative weight
+        minus half its own weight crosses alpha*total; value-space
+        bisection lumps equal-valued items into one mass and uses the
+        half-mass rule. For distinct residuals (the generic case) the
+        two rules select the same element; under exact ties they can
+        pick adjacent values."""
+        Ly = self.layout
+        lanes = jnp.arange(Ly.num_lanes, dtype=jnp.int32)
+        realm = lanes < jnp.asarray(n, jnp.int32)
+        resid = (plane.get_f32(st.data, Ly.label)
+                 - plane.get_f32(st.data, Ly.score))
+        i = jax.lax.bitcast_convert_type(resid, jnp.int32)
+        u = jax.lax.bitcast_convert_type(i, jnp.uint32)
+        ukey = jnp.where(i < 0, ~u, u | jnp.uint32(0x80000000))
+
+        sorted_starts, order = self._pos_leaf_terms(st)
+
+        def per_lane(v_leaf, dtype):
+            """Broadcast a [L] per-leaf vector to lanes by window —
+            telescoping step sums, exact in modular uint32 arithmetic."""
+            vs = v_leaf[order].astype(dtype)
+            d = vs - jnp.concatenate([jnp.zeros((1,), dtype), vs[:-1]])
+            steps = (lanes[:, None] >= sorted_starts[None, :])
+            return jnp.sum(jnp.where(steps, d[None, :], 0), axis=1)
+
+        ends = st.leaf_start + st.leaf_count
+        sidx = jnp.maximum(st.leaf_start, 1) - 1
+
+        def seg_sums(c):
+            """Per-leaf window sums of a [R] vector via one cumsum.
+            Shard-locally EMPTY windows at start 0 would read lane 0's
+            value (ends==0 -> cs[0]); zero them explicitly BEFORE the
+            psum so no shard contributes phantom mass."""
+            cs = jnp.cumsum(c)
+            lo = jnp.where(st.leaf_start > 0, cs[sidx], 0)
+            raw = cs[jnp.maximum(ends, 1) - 1] - lo
+            return self._psum(jnp.where(st.leaf_count > 0, raw, 0))
+
+        L = self.num_leaves
+        lid = jnp.arange(L, dtype=jnp.int32)
+        cnt = st.leaf_count_g
+        valid = (lid < st.n_leaves) & (cnt > 0)
+
+        def bisect(pred_of_mid, shape):
+            """Smallest uint32 key with monotone pred(mid) true."""
+            lo = jnp.zeros(shape, jnp.uint32)
+            hi = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+
+            def step(_, lh):
+                lo, hi = lh
+                mid = lo + (hi - lo) // jnp.uint32(2)
+                p = pred_of_mid(mid)
+                return (jnp.where(p, lo, mid + jnp.uint32(1)),
+                        jnp.where(p, mid, hi))
+
+            lo, hi = jax.lax.fori_loop(0, 32, step, (lo, hi))
+            return lo
+
+        def key_to_f32(k):
+            neg = k < jnp.uint32(0x80000000)
+            u_orig = jnp.where(neg, ~k, k & jnp.uint32(0x7FFFFFFF))
+            return jax.lax.bitcast_convert_type(u_orig, jnp.float32)
+
+        def order_stat_keys(targets):
+            """Integer-exact order statistics: per-leaf uint32 keys at
+            ascending 0-indexed ``targets`` [L, T]. Counts are int32
+            cumsums, so these bisections cannot jitter."""
+            T_ = targets.shape[1]
+
+            def pred(mid):
+                cm = jnp.stack([per_lane(mid[:, t], jnp.uint32)
+                                for t in range(T_)], axis=0)   # [T, R]
+                le = (ukey[None, :] <= cm) & realm[None, :]
+                cnts = jnp.stack(
+                    [seg_sums(le[t].astype(jnp.int32)) for t in range(T_)],
+                    axis=1)                                    # [L, T]
+                return cnts >= targets + 1
+
+            return bisect(pred, targets.shape)
+
+        if not weighted:
+            # PercentileFun: DESCENDING selection at float_pos =
+            # (1-alpha)*cnt via ArgMaxAtK — in ascending ranks the two
+            # selected order statistics are cnt-pos and cnt-pos-1, and
+            # the result is d[pos-1] - (d[pos-1]-d[pos])*bias. Edge
+            # rules (pos<1 -> max, pos>=cnt -> min, cnt<=1 -> the
+            # value) mirror the macro exactly.
+            cf = cnt.astype(jnp.float32)
+            float_pos = (1.0 - jnp.float32(alpha)) * cf
+            pos = jnp.floor(float_pos).astype(jnp.int32)
+            bias = float_pos - pos.astype(jnp.float32)
+            edge_max = pos < 1                     # includes cnt <= 1
+            edge_min = pos >= cnt
+            r_hi = jnp.clip(cnt - pos, 0, jnp.maximum(cnt - 1, 0))
+            r_lo = jnp.clip(cnt - pos - 1, 0, jnp.maximum(cnt - 1, 0))
+            r_hi = jnp.where(edge_max, jnp.maximum(cnt - 1, 0),
+                             jnp.where(edge_min, 0, r_hi))
+            r_lo = jnp.where(edge_max | edge_min, r_hi, r_lo)
+            bias = jnp.where(edge_max | edge_min, 0.0, bias)
+            keys = order_stat_keys(jnp.stack([r_hi, r_lo], axis=1))
+            v1 = key_to_f32(keys[:, 0])            # d[pos-1]
+            v2 = key_to_f32(keys[:, 1])            # d[pos]
+            out = v1 - (v1 - v2) * bias
+        else:
+            # WeightedPercentileFun: ascending weighted CDF,
+            # pos = upper_bound(cdf, alpha*total); returns the value at
+            # pos, except the (next-step-weight >= 1.0) branch which
+            # interpolates with a negative factor — mirrored as-is.
+            # The value-space bisection uses f32 mass sums (the [R]
+            # cumsum carries ~1e-7*prefix rounding and the host uses
+            # f64), so the crossing is then SNAPPED to a true data key
+            # with integer-exact rank bisections; under exact residual
+            # ties the per-index CDF is approximated at value
+            # granularity (tie block = one mass).
+            w = plane.get_f32(st.data, Ly.weight)
+            w = jnp.where(realm, w, 0.0)
+            wtot = seg_sums(w)
+            thresh = jnp.float32(alpha) * wtot                 # [L]
+
+            def wle_at(mid):
+                cm = per_lane(mid, jnp.uint32)                 # [R]
+                return seg_sums(jnp.where((ukey <= cm) & realm, w, 0.0))
+
+            b = bisect(lambda mid: wle_at(mid) > thresh, (L,))
+            # snap to the data key at the crossing: rank = count(< b),
+            # clamped like the reference's pos = min(pos, cnt-1)
+            cmb = per_lane(b, jnp.uint32)
+            c_lt = seg_sums(((ukey < cmb) & realm).astype(jnp.int32))
+            c_lt = jnp.minimum(c_lt, jnp.maximum(cnt - 1, 0))
+            prev_rank = jnp.maximum(c_lt - 1, 0)
+            keys = order_stat_keys(
+                jnp.stack([c_lt, prev_rank], axis=1))
+            v2k, v1k = keys[:, 0], keys[:, 1]
+            v2 = key_to_f32(v2k)                   # value at pos
+            v1 = key_to_f32(v1k)                   # value at pos-1
+            # masses at the snapped key: cdf[pos] and the next step
+            cm2 = per_lane(v2k, jnp.uint32)
+            wle2 = seg_sums(jnp.where((ukey <= cm2) & realm, w, 0.0))
+            c_le2 = seg_sums(((ukey <= cm2) & realm).astype(jnp.int32))
+            nxt = order_stat_keys(
+                jnp.minimum(c_le2, jnp.maximum(cnt - 1, 0))[:, None])[:, 0]
+            cm3 = per_lane(nxt, jnp.uint32)
+            wle3 = seg_sums(jnp.where((ukey <= cm3) & realm, w, 0.0))
+            wnext = wle3 - wle2
+            pos0 = c_lt == 0
+            islast = c_le2 >= cnt
+            interp = (~pos0) & (~islast) & (wnext >= 1.0)
+            out_i = (thresh - wle2) / jnp.where(wnext == 0, 1.0, wnext) \
+                * (v2 - v1) + v1
+            out = jnp.where(interp, out_i, v2)
+        return jnp.where(valid, out, 0.0).astype(jnp.float32)
+
     # ------------------------------------------------------------------
     def _grow_tree(self, codes_planes, grad, hess, perm, bag_cnt,
                    feature_mask, bins_rowmajor=None,
@@ -1007,6 +1200,15 @@ class FusedSerialGrower:
         data = plane.set_gh(data, Ly, g, h)
 
         ta, st = self._grow_tree_core(data, n, feature_mask)
+
+        renew = (self.objective.persistent_renew_spec()
+                 if self.objective is not None else None)
+        if renew is not None:
+            # leaf refit BEFORE shrinkage, like the reference's
+            # RenewTreeOutput -> Shrinkage order (gbdt.cpp:379-386)
+            alpha, weighted = renew
+            ta = dict(ta, leaf_value=self._renew_leaf_outputs(
+                st, n, alpha, weighted))
 
         vals = ta["leaf_value"] * shrinkage
         add = self._score_add_by_pos(st, vals.astype(jnp.float32))

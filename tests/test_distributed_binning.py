@@ -139,26 +139,18 @@ def test_training_with_distributed_mappers():
     assert auc > 0.8
 
 
-def test_sparse_input_warns_and_matches_local():
-    """num_machines>1 + CSR input: bin finding falls back to the local
-    path with a LOUD warning — and in single-controller mode the
-    boundaries are identical to the dense distributed protocol's, so
-    nothing silently changes (round-4 verdict item 10)."""
+def test_sparse_input_takes_protocol_and_matches_local():
+    """num_machines>1 + CSR input runs the distributed protocol (the
+    round-4 dense-only fallback is gone) and, in single-controller
+    mode, produces boundaries identical to single-machine sparse
+    construction — num_machines partitions work, never bin quality."""
     import scipy.sparse as sp
-    from lightgbm_tpu.utils import log as lgb_log
     rng = np.random.RandomState(3)
     dense = rng.randn(2000, 5) * (rng.rand(2000, 5) < 0.3)
     X = sp.csr_matrix(dense)
     y = (dense[:, 0] > 0).astype(np.float32)
     cfg = Config.from_params({"num_machines": WORLD})
-    captured = []
-    lgb_log.register_log_callback(captured.append)
-    try:
-        ds = BinnedDataset.from_matrix(X, cfg, label=y)
-    finally:
-        lgb_log.register_log_callback(None)
-    assert any("sparse input" in m for m in captured), \
-        f"missing the sparse-fallback warning in {captured}"
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
     cfg1 = Config.from_params({"verbose": -1})
     ds1 = BinnedDataset.from_matrix(X, cfg1, label=y)
     assert len(ds.bin_mappers) == len(ds1.bin_mappers)
@@ -195,4 +187,43 @@ def test_from_matrix_uses_distributed_protocol():
     ds1 = BinnedDataset.from_matrix(X.astype(np.float32), cfg1,
                                     label=(X[:, 0] > 0).astype(np.float32))
     for a, b in zip(ds.bin_mappers, ds1.bin_mappers):
+        np.testing.assert_array_equal(a.bin_upper_bound, b.bin_upper_bound)
+
+
+def test_sparse_distributed_binning_bit_identical():
+    """Round-5: CSR input routes through the SAME ownership-partition/
+    allgather protocol (no dense fallback), and boundaries are
+    bit-identical to the dense protocol on the same data — the CSC
+    column slices drop only structural zeros, which the
+    |v| > kZeroThreshold filter drops from the dense column anyway
+    (reference dataset_loader.cpp:917-990 shards features over machines
+    regardless of storage)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(11)
+    n, f = 4000, 12
+    dense = rng.randn(n, f) * (rng.rand(n, f) < 0.15)   # ~85% zeros
+    dense[rng.rand(n, f) < 0.01] = np.nan               # explicit NaNs
+    X = sp.csr_matrix(np.nan_to_num(dense, nan=0.0))
+    # keep NaN entries stored explicitly, as a CSR from raw data would
+    X = sp.csr_matrix(np.where(np.isnan(dense), np.nan, dense))
+    cfg = Config.from_params({"num_machines": WORLD, "verbose": -1,
+                              "use_missing": True})
+    from lightgbm_tpu.io.distributed import distributed_find_bin_mappers
+    want = distributed_find_bin_mappers(
+        np.asarray(dense, dtype=np.float64), cfg)
+    got = distributed_find_bin_mappers(X.tocsc(), cfg)
+    assert len(want) == len(got) == f
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a.bin_upper_bound, b.bin_upper_bound)
+        assert a.bin_type == b.bin_type
+        assert a.num_bin == b.num_bin
+
+    # the full construct path accepts CSR with num_machines > 1 and
+    # matches the single-machine sparse construct bit-for-bit
+    y = rng.rand(n)
+    ds_mc = BinnedDataset.from_matrix(X, cfg, label=y)
+    ds_1 = BinnedDataset.from_matrix(
+        X, Config.from_params({"verbose": -1, "use_missing": True}),
+        label=y)
+    for a, b in zip(ds_mc.bin_mappers, ds_1.bin_mappers):
         np.testing.assert_array_equal(a.bin_upper_bound, b.bin_upper_bound)

@@ -300,6 +300,29 @@ class TestTrainingControl:
                         verbose_eval=False)
         assert auc_score(y, bst.predict(X)) > 0.95
 
+    def test_goss_sampling_stays_on_device(self):
+        """The GOSS round (top-k by |g*h|, rest sampling, perm build)
+        must dispatch without pulling [N] arrays to host — asserted by
+        a device-to-host transfer guard around the sampled-iteration
+        _bagging call (reference goss.hpp computes on its own arrays;
+        the TPU analogue must not sync the tunnel per iteration)."""
+        import jax
+        X, y = make_binary(4000)
+        bst = lgb.train(dict(P, objective="binary", boosting="goss",
+                             learning_rate=0.5),
+                        lgb.Dataset(X, label=y), num_boost_round=3,
+                        verbose_eval=False, keep_training_booster=True)
+        g = bst._gbdt
+        assert g.iter >= int(1.0 / 0.5), "need a sampled iteration"
+        with jax.transfer_guard_device_to_host("disallow"):
+            g._bagging(g.iter)
+        assert g.bag_data_cnt < g.num_data
+        # the permutation is a valid [bag | oob] row permutation
+        perm = np.asarray(g._perm)
+        assert np.array_equal(np.sort(perm), np.arange(g.num_data))
+        bag = perm[:g.bag_data_cnt]
+        assert np.array_equal(bag, np.sort(bag))  # stable ascending bag
+
     def test_dart(self):
         X, y = make_binary()
         bst = lgb.train(dict(P, objective="binary", boosting="dart",
