@@ -598,6 +598,25 @@ class GBDT:
             self._forest_cache = (key, forest)
         return self._forest_cache[1], models
 
+    def _path_forest(self, start_iteration: int, num_iteration: int):
+        """Cached PathForest (models/pathforest.py) — the gather-free
+        MXU traversal; None when the model is out of its scope
+        (categorical splits)."""
+        from ..models.pathforest import PathForest, build_path_tables
+        models = self._used_models(start_iteration, num_iteration)
+        key = (start_iteration, num_iteration, len(self.models),
+               getattr(self, "_pred_revision", 0))
+        cache = getattr(self, "_path_forest_cache", None)
+        if cache is None or cache[0] != key:
+            forest = None
+            if models:
+                tabs = build_path_tables(models)
+                if tabs is not None:
+                    forest = PathForest(models,
+                                        self.num_tree_per_iteration, tabs)
+            self._path_forest_cache = (key, forest)
+        return self._path_forest_cache[1]
+
     @staticmethod
     def _pad_rows(x: np.ndarray):
         """Pad the batch to a power-of-two bucket (>=8) so the jitted
@@ -618,7 +637,7 @@ class GBDT:
         whole path is one host→device upload and one program — every
         extra transfer costs a full tunnel round trip on remote
         accelerators, so conversion/averaging stay device-side too."""
-        forest, models = self._packed_forest(start_iteration, num_iteration)
+        models = self._used_models(start_iteration, num_iteration)
         k = self.num_tree_per_iteration
         n_in = np.asarray(x).shape[0]
         if not models:
@@ -637,11 +656,22 @@ class GBDT:
         xp, n = self._pad_rows(np.asarray(x, dtype=np.float32))
         xd = jnp.asarray(xp)
         cfg = self.config
+        path_forest = None
+        if (os.environ.get("LGBM_TPU_PRED_PATH", "1") != "0"
+                and not (cfg is not None and cfg.pred_early_stop)):
+            path_forest = self._path_forest(start_iteration, num_iteration)
         if cfg is not None and cfg.pred_early_stop:
+            forest, _ = self._packed_forest(start_iteration, num_iteration)
             score = forest.raw_scores_early_stop(
                 xd, max(1, cfg.pred_early_stop_freq),
                 float(cfg.pred_early_stop_margin))
+        elif path_forest is not None:
+            # gather-free MXU path traversal (models/pathforest.py);
+            # the walker covers categorical/oversized models — and is
+            # only BUILT on the branches that use it
+            score = path_forest.raw_scores(xd)
         else:
+            forest, _ = self._packed_forest(start_iteration, num_iteration)
             score = forest.raw_scores(xd)
         if self.average_output:
             score = score / (len(models) // k)

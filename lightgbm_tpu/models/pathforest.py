@@ -1,0 +1,165 @@
+"""Path-mask batch inference: MXU traversal with tree structure as data.
+
+The packed-forest walker (models/forest.py) pays two per-row gathers
+per tree level — the TPU per-row gather toll (~10 ns/row) makes batch
+prediction ~0.28 ms/row at 500 trees (docs/PERF_NOTES.md), three orders
+slower than the reference CPU's L1-cache node chase
+(src/application/predictor.hpp:160, gbdt_prediction.cpp).
+
+This predictor removes every per-row gather AND every per-level
+sequential step. Per tree, the structure rides as data:
+
+1. node conditions, all at once: sel = x @ onehot(node_features) — one
+   [N, F] x [F, Nd] matmul (f32 HIGHEST: the MXU cannot round the
+   selected value) + the NumericalDecision elementwise rules.
+2. leaf flags, all at once: a leaf is reached iff ZERO of its path
+   conditions mismatch. Two 0/1 matmuls count mismatches:
+       mism = (1 - go_left) @ M_left + go_left @ M_right
+   where M_left[n, l] = 1 iff leaf l's path goes LEFT at node n.
+   0/1 inputs with f32 accumulation are exact, K = Nd fills the MXU,
+   and the cost is independent of tree DEPTH — a leaf-wise chain tree
+   costs the same as a balanced one.
+3. score += flag @ leaf_values (one matvec).
+
+Trees ride a lax.scan, so ONE compiled program serves every model —
+no per-tree unrolling, no recompiles when the model changes.
+
+Scope: numerical splits only (categorical models fall back to the
+walker); no prediction early stop.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .forest import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK
+
+K_ZERO = 1e-35
+
+
+# host-memory ceiling for the [T, Nd, L] path matrices — beyond this
+# the compact walker is the better representation anyway (the matrices
+# grow O(T * L^2) while the walker grows O(T * L))
+PATH_TABLE_BUDGET = 1 << 29          # 512 MB (f32 host side)
+
+
+def build_path_tables(trees: Sequence) -> Optional[dict]:
+    """Per-node tables + [Nd, L] path matrices from materialized Trees,
+    or None when a tree has categorical splits or the matrices would
+    exceed PATH_TABLE_BUDGET."""
+    T = len(trees)
+    L = max([max(t.num_leaves, 1) for t in trees] or [1])
+    Nd = max(L - 1, 1)
+    if 2 * T * Nd * L * 4 > PATH_TABLE_BUDGET:
+        return None
+    # categorical check BEFORE any large allocation
+    for t in trees:
+        if t.num_leaves > 1 and (
+                t.decision_type[:t.num_nodes] & K_CATEGORICAL_MASK).any():
+            return None
+
+    feats = np.zeros((T, Nd), np.int32)
+    thr = np.zeros((T, Nd), np.float32)
+    mt = np.zeros((T, Nd), np.int32)
+    dl = np.zeros((T, Nd), bool)
+    m_left = np.zeros((T, Nd, L), np.float32)
+    m_right = np.zeros((T, Nd, L), np.float32)
+    values = np.zeros((T, L), np.float32)
+
+    for i, t in enumerate(trees):
+        values[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        if t.num_leaves <= 1:
+            continue
+        dt = t.decision_type[:t.num_nodes]
+        n = t.num_nodes
+        feats[i, :n] = t.split_feature[:n]
+        thr[i, :n] = t.threshold[:n]
+        mt[i, :n] = (dt.astype(np.int32) >> 2) & 3
+        dl[i, :n] = (dt & K_DEFAULT_LEFT_MASK) != 0
+        # DFS from the root filling each leaf's path membership
+        stack = [(0, [])]
+        while stack:
+            node, path = stack.pop()
+            if node < 0:
+                leaf = -node - 1
+                for nd, left in path:
+                    (m_left if left else m_right)[i, nd, leaf] = 1.0
+                continue
+            stack.append((int(t.left_child[node]), path + [(node, True)]))
+            stack.append((int(t.right_child[node]), path + [(node, False)]))
+
+    return dict(feats=feats, thr=thr, mt=mt, dl=dl, m_left=m_left,
+                m_right=m_right, values=values, num_leaves=L)
+
+
+class PathForest:
+    """Device tables + the scan-over-trees inference program."""
+
+    def __init__(self, trees: Sequence, num_classes: int,
+                 tables: Optional[dict] = None) -> None:
+        tabs = tables if tables is not None else build_path_tables(trees)
+        assert tabs is not None, "caller must check build_path_tables"
+        self.num_trees = len(trees)
+        self.num_classes = max(num_classes, 1)
+        self.num_features = int(tabs["feats"].max()) + 1
+        self.feats = jnp.asarray(tabs["feats"])
+        self.thr = jnp.asarray(tabs["thr"])
+        self.mt = jnp.asarray(tabs["mt"])
+        self.dl = jnp.asarray(tabs["dl"])
+        self.m_left = jnp.asarray(tabs["m_left"], jnp.bfloat16)
+        self.m_right = jnp.asarray(tabs["m_right"], jnp.bfloat16)
+        self.values = jnp.asarray(tabs["values"])
+        self.tree_class = jnp.asarray(
+            np.arange(self.num_trees, dtype=np.int32) % self.num_classes)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def raw_scores(self, x: jax.Array) -> jax.Array:
+        """[num_classes, N] raw scores; x [N, F] f32 raw features."""
+        n, f_in = x.shape
+        F = max(self.num_features, 1)
+        if f_in < F:
+            x = jnp.pad(x, ((0, 0), (0, F - f_in)))
+        x = x[:, :F].astype(jnp.float32)
+        nanmask = jnp.isnan(x)
+        x0 = jnp.where(nanmask, 0.0, x)               # [N, F]
+        xna = nanmask.astype(jnp.float32)
+        fio = jnp.arange(F, dtype=jnp.int32)
+
+        def tree_step(score, xs):
+            feats, thr, mt, dl, m_left, m_right, vals, cls = xs
+            # 1. all node conditions: exact one-hot select (HIGHEST so
+            # the MXU cannot round the feature value), then the
+            # NumericalDecision rules of models/forest.py _leaf_of
+            E = (fio[:, None] == feats[None, :]).astype(jnp.float32)
+            sel = jnp.dot(x0, E, precision=jax.lax.Precision.HIGHEST)
+            na = jnp.dot(xna, E, precision=jax.lax.Precision.HIGHEST) > 0.5
+            is_zero = jnp.abs(sel) <= K_ZERO
+            is_missing = (((mt[None, :] == 1) & is_zero)
+                          | ((mt[None, :] == 2) & na))
+            go_left = jnp.where(is_missing, dl[None, :],
+                                sel <= thr[None, :])
+            gl = go_left.astype(jnp.bfloat16)
+            # 2. mismatch counts: 0/1 matmuls, f32 accumulation — exact
+            # (integers <= Nd), K = Nd fills the MXU
+            mism = (jnp.dot(1.0 - gl, m_left,
+                            preferred_element_type=jnp.float32)
+                    + jnp.dot(gl, m_right,
+                              preferred_element_type=jnp.float32))
+            flag = (mism == 0).astype(jnp.float32)     # [N, L]
+            # 3. leaf values: padded leaf slots carry value 0
+            contrib = jnp.dot(flag, vals,
+                              precision=jax.lax.Precision.HIGHEST)
+            score = jax.lax.dynamic_update_index_in_dim(
+                score, score[cls] + contrib, cls, axis=0)
+            return score, None
+
+        score0 = jnp.zeros((self.num_classes, n), jnp.float32)
+        score, _ = jax.lax.scan(
+            tree_step, score0,
+            (self.feats, self.thr, self.mt, self.dl, self.m_left,
+             self.m_right, self.values, self.tree_class))
+        return score

@@ -160,14 +160,20 @@ def build_codes_planes(codes: jax.Array, layout: PlaneLayout) -> jax.Array:
 
 
 def build_codes_planes_chunked(codes_host, layout: PlaneLayout,
-                               row_chunk: int = 1 << 21) -> jax.Array:
+                               row_chunk: Optional[int] = None,
+                               chunk_bytes: int = 1 << 29) -> jax.Array:
     """Pack HOST-resident bin codes into the planar layout in row
     chunks, so the transient row-major device upload is bounded by
-    ``row_chunk * G`` bytes instead of the full [N, G] matrix — at the
-    Allstate shape (13.2M x 581 bundles) a one-shot upload is 7.7 GB
-    sitting next to the 4.3 GB planar state and OOMs HBM before the
-    async free lands."""
+    ``chunk_bytes`` instead of the full [N, G] matrix — at the Allstate
+    shape (13.2M x 581 bundles) a one-shot upload is 7.7 GB sitting
+    next to the 4.3 GB planar state and OOMs HBM before the async free
+    lands. The chunk is derived from BYTES, not rows, so wide datasets
+    with few rows are bounded the same way."""
     n = codes_host.shape[0]
+    if row_chunk is None:
+        row_bytes = max(1, int(codes_host.shape[1])
+                        * np.dtype(codes_host.dtype).itemsize)
+        row_chunk = max(1 << 16, chunk_bytes // row_bytes)
     if n <= row_chunk:
         return build_codes_planes(jnp.asarray(codes_host), layout)
     out = jnp.zeros((layout.code_planes, layout.num_lanes), jnp.int32)

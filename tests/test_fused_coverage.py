@@ -95,7 +95,11 @@ def test_fused_reject_reasons_are_named():
     assert "extra_trees" in _reason({"extra_trees": True}, X, y)
     assert "cegb" in _reason({"cegb_penalty_split": 1.0}, X, y)
     assert "tpu_fused" in _reason({"tpu_fused": False}, X, y)
-    r = _reason({"objective": "regression_l1"}, X, y)
+    # round-5: renew objectives run fused via the in-program leaf refit
+    # — only sampling configs (which break the persistent path) reject
+    assert _reason({"objective": "regression_l1"}, X, y) is None
+    r = _reason({"objective": "regression_l1", "bagging_freq": 1,
+                 "bagging_fraction": 0.8}, X, y)
     assert r is not None and "renew" in r
     cfg = Config.from_params(dict(P, objective="binary"))
     ds = BinnedDataset.from_matrix(X, cfg, label=y)

@@ -1,0 +1,100 @@
+"""PathForest (gather-free MXU batch inference) vs the packed-forest
+walker — the oracle is the traversal the rest of the suite already
+validates against the reference semantics (models/forest.py _leaf_of;
+reference gbdt_prediction.cpp)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+P = {"verbose": -1, "min_data_in_leaf": 5}
+
+
+def _walker_predict(bst, X, **kw):
+    os.environ["LGBM_TPU_PRED_PATH"] = "0"
+    try:
+        bst._gbdt._path_forest_cache = None
+        return bst.predict(X, **kw)
+    finally:
+        os.environ.pop("LGBM_TPU_PRED_PATH", None)
+
+
+@pytest.mark.parametrize("objective,extra", [
+    ("binary", {}),
+    ("regression", {"num_leaves": 63}),
+    ("multiclass", {"num_class": 3}),
+])
+def test_pathforest_matches_walker(objective, extra):
+    rng = np.random.RandomState(7)
+    X = rng.randn(3000, 8)
+    if objective == "multiclass":
+        y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(int) + \
+            (X[:, 0] > 0.5).astype(int)
+    elif objective == "binary":
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    else:
+        y = 2 * X[:, 0] - X[:, 1] + 0.1 * rng.randn(len(X))
+    bst = lgb.train(dict(P, objective=objective, **extra),
+                    lgb.Dataset(X, label=y), num_boost_round=12,
+                    verbose_eval=False, keep_training_booster=True)
+    assert bst._gbdt._path_forest(0, -1) is not None, \
+        "numerical model must take the path forest"
+    want = _walker_predict(bst, X)
+    bst._gbdt._path_forest_cache = None
+    got = bst.predict(X)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_pathforest_missing_values_match_walker():
+    """NaN routing (missing_type Zero/NaN + default_left) must agree
+    with the walker bit-for-bit."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(4000, 6)
+    X[rng.rand(*X.shape) < 0.2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0).astype(float)
+    bst = lgb.train(dict(P, objective="binary", use_missing=True),
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False, keep_training_booster=True)
+    Xt = rng.randn(500, 6)
+    Xt[rng.rand(*Xt.shape) < 0.3] = np.nan
+    Xt[::7] = 0.0
+    want = _walker_predict(bst, Xt)
+    bst._gbdt._path_forest_cache = None
+    got = bst.predict(Xt)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_pathforest_rejects_categorical_models():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 5)
+    X[:, 2] = rng.randint(0, 12, 2000)
+    y = (X[:, 2] % 3 == 0).astype(float)
+    bst = lgb.train(dict(P, objective="binary", categorical_feature=[2]),
+                    lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False, keep_training_booster=True)
+    tree = bst._gbdt.models[0]
+    from lightgbm_tpu.models.forest import K_CATEGORICAL_MASK
+    has_cat = any((t.decision_type[:t.num_nodes] & K_CATEGORICAL_MASK).any()
+                  for t in bst._gbdt.models if t.num_leaves > 1)
+    assert has_cat, "model should contain a categorical split"
+    assert bst._gbdt._path_forest(0, -1) is None
+    # prediction still works through the walker
+    p = bst.predict(X[:100])
+    assert np.isfinite(p).all()
+
+
+def test_pathforest_model_file_round_trip(tmp_path):
+    """A model loaded from the reference text format predicts
+    identically through the path forest."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(2000, 6)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    bst = lgb.train(dict(P, objective="binary"), lgb.Dataset(X, label=y),
+                    num_boost_round=8, verbose_eval=False)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X[:200]), bst.predict(X[:200]),
+                               rtol=1e-6, atol=1e-6)
