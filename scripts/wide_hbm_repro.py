@@ -1,0 +1,83 @@
+"""Fast repro for the wide-EFB (Allstate-shape) training HBM OOM.
+
+Builds the 13.2M x 581-bundle 4-bit planar geometry directly from
+random codes (no CSR generation, no EFB search — ~2 min instead of
+~40), then runs a few persistent iterations. Shapes match
+scripts/sparse_scale.py exactly: P=80 planes x 13.37M lanes.
+
+Env: REPRO_ROWS (default 13_200_000), REPRO_COLS (581), REPRO_ITERS (3).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("REPRO_ROWS", 13_200_000))
+COLS = int(os.environ.get("REPRO_COLS", 581))
+ITERS = int(os.environ.get("REPRO_ITERS", 3))
+BINS = 16
+
+
+def main():
+    import jax
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(repo, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+    from lightgbm_tpu.io.binning import BinMapper
+    from lightgbm_tpu.boosting.gbdt import create_boosting
+    from lightgbm_tpu.objective.functions import create_objective
+
+    rng = np.random.RandomState(0)
+    t0 = time.time()
+    bins = rng.randint(0, BINS, size=(ROWS, COLS), dtype=np.uint8)
+    y = ((bins[:, 0] > 7) ^ (bins[:, 1] > 9)
+         | (rng.rand(ROWS) < 0.1)).astype(np.float64)
+    print(f"codes generated in {time.time() - t0:.0f}s", flush=True)
+
+    proto = BinMapper()
+    proto.find_bin(rng.rand(5000) * 16, 5000, BINS)
+    ds = BinnedDataset()
+    ds.num_data = ROWS
+    ds.num_total_features = COLS
+    ds.bins = bins
+    ds.bin_mappers = [proto] * COLS
+    ds.real_feature_index = list(range(COLS))
+    ds.inner_feature_index = {f: f for f in range(COLS)}
+    ds.feature_names = [f"Column_{i}" for i in range(COLS)]
+    ds.max_bin = BINS
+    ds.metadata = Metadata(ROWS)
+    ds.metadata.set_label(y)
+
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 255,
+                              "max_bin": BINS, "verbose": -1,
+                              "min_data_in_leaf": 20})
+    gbdt = create_boosting("gbdt")
+    obj = create_objective(cfg)
+    gbdt.init(cfg, ds, obj, [])
+    print(f"grower: fused={gbdt._fused is not None} "
+          f"persist={gbdt._fused_persist}", flush=True)
+    if gbdt._fused is not None:
+        Ly = gbdt._fused.layout
+        print(f"layout: P={Ly.num_planes} R={Ly.num_lanes} "
+              f"bits={Ly.code_bits} tile={Ly.tile} "
+              f"part={gbdt._fused._part_method}", flush=True)
+
+    for i in range(ITERS):
+        t0 = time.time()
+        gbdt.train_one_iter()
+        jax.block_until_ready(gbdt.device_score_state())
+        print(f"iter {i}: {time.time() - t0:.1f}s", flush=True)
+    print("OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
