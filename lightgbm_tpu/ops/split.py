@@ -341,11 +341,27 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
     # ---- sorted many-vs-many ----------------------------------------
     usable = valid_bin & (cnt >= cfg.cat_smooth)
     ctr = jnp.where(usable, g / (h + cfg.cat_smooth), np.inf)
-    order = jnp.argsort(ctr, axis=1, stable=True)                   # [F,B]
+    # stable sort WITHOUT argsort/gather: both pay per-element tolls on
+    # TPU inside the fused while-loop (this scan runs twice per split).
+    # Ranks come from a pairwise compare matrix (stable ties by original
+    # index), and the sorted arrays from one exact permutation einsum —
+    # [F, B, B] intermediates stay in VMEM and fuse.
+    lt = ctr[:, :, None] < ctr[:, None, :]                  # j sorts before i
+    eq_before = (ctr[:, :, None] == ctr[:, None, :]) \
+        & (bin_ar[0][None, :, None] < bin_ar[0][None, None, :])
+    rank = (lt | eq_before).sum(axis=1).astype(jnp.int32)   # [F, B]
     used_bin = usable.sum(axis=1)                                    # [F]
-    sg = jnp.take_along_axis(g, order, 1)
-    shh = jnp.take_along_axis(h, order, 1)
-    scnt = jnp.take_along_axis(cnt, order, 1)
+    perm = (rank[:, :, None] ==
+            bin_ar[0][None, None, :]).astype(jnp.float32)   # [F, B(i), B(k)]
+    stacked = jnp.stack([g, h, cnt.astype(jnp.float32),
+                         bin_ar[0][None, :] * jnp.ones((f, 1), jnp.float32)],
+                        axis=-1)                            # [F, B, 4]
+    sorted_all = jnp.einsum("fik,fic->fkc", perm, stacked,
+                            precision=jax.lax.Precision.HIGHEST)
+    sg = sorted_all[:, :, 0]
+    shh = sorted_all[:, :, 1]
+    scnt = sorted_all[:, :, 2].astype(jnp.int32)
+    order = sorted_all[:, :, 3].astype(jnp.int32)           # [F, B]
     max_num_cat = jnp.minimum(cfg.max_cat_threshold, (used_bin + 1) // 2)[:, None]
     pos_ar = bin_ar  # prefix position index
 
@@ -395,11 +411,16 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
 
     fwd = directional(sg, shh, scnt)
     # backward: prefixes taken from the high end of the used portion:
-    # position i reads sorted slot used_bin-1-i
-    idx_rev = jnp.mod(used_bin[:, None] - 1 - bin_ar, b_dim)
-    bwd = directional(jnp.take_along_axis(sg, idx_rev, 1),
-                      jnp.take_along_axis(shh, idx_rev, 1),
-                      jnp.take_along_axis(scnt, idx_rev, 1))
+    # position k reads sorted slot (used_bin-1-k) mod B — as an exact
+    # permutation einsum, like the sort above (no per-element gathers)
+    rev_src = jnp.mod(used_bin[:, None] - 1 - bin_ar, b_dim)  # [F, B]
+    perm_rev = (rev_src[:, :, None] ==
+                bin_ar[0][None, None, :]).astype(jnp.float32)
+    sorted_rev = jnp.einsum("fkj,fjc->fkc", perm_rev,
+                            sorted_all[:, :, :3],
+                            precision=jax.lax.Precision.HIGHEST)
+    bwd = directional(sorted_rev[:, :, 0], sorted_rev[:, :, 1],
+                      sorted_rev[:, :, 2].astype(jnp.int32))
 
     # combine three candidate families; order: onehot, fwd, bwd
     all_gain = jnp.concatenate([oh[0], fwd[0], bwd[0]], axis=1)      # [F,3B]
