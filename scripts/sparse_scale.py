@@ -119,13 +119,14 @@ def main():
     auc = 1.0 - (np.sum(np.arange(1, len(yy) + 1)[yy])
                  - pos * (pos + 1) / 2) / (pos * neg)
 
-    # deterministic device-footprint accounting (memory_stats is not
-    # exposed through the accelerator tunnel)
+    # deterministic device-footprint accounting of the TRAINING loop
+    # (memory_stats is not exposed through the accelerator tunnel).
+    # The row-major traverse bins stay HOST-side: the grower's lazy
+    # property (round-5 fix) never uploads them on the persistent path,
+    # and prediction uses the raw-feature path forest
     acct = {}
     if layout is not None:
         acct["planar state [P,R] i32"] = layout.num_planes * layout.num_lanes * 4
-        acct["row-major bins (traverse path)"] = int(
-            np.prod(fused.bins.shape)) * fused.bins.dtype.itemsize
         wl = (fused._caps[-1] // layout.tile + 1) * layout.tile
         acct["partition window buffer"] = layout.num_planes * (
             wl + layout.tile + 256) * 4
@@ -133,6 +134,10 @@ def main():
             acct["histogram pool [L,F,B,2]"] = (fused.num_leaves *
                                                 fused.num_features *
                                                 fused.max_num_bin * 2 * 4)
+        dev_bins = bst._gbdt.train_data._device_bins
+        if dev_bins is not None:
+            acct["row-major bins (resident!)"] = int(
+                np.prod(dev_bins.shape)) * dev_bins.dtype.itemsize
     total = sum(acct.values())
 
     lines = [
