@@ -479,6 +479,7 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     # (plane.make_layout guarantees grad % 8 <= 6)
     gh_blk, gh_off = grad_plane // 8, grad_plane % 8
     assert gh_off <= 6, grad_plane
+    assert cap % Rb == 0, (cap, Rb)  # window coverage needs Rb | cap
     nblk = cap // Rb + 1
     assert nblk * Rb <= R
 

@@ -48,24 +48,32 @@ import jax.numpy as jnp
 LANE = 128          # TPU lane count; DMA offsets/sizes must align to it
 import os as _os
 DEF_TILE = int(_os.environ.get("LGBM_TPU_TILE", 4096))
+# ceiling for the per-ladder-branch processing tile (see fused.py
+# _branch_tile): the partition/histogram kernels are per-STEP-overhead
+# bound (~4 us/step measured, scripts/part_micro.py), so large leaf
+# windows process in tiles up to this size
+MAX_TILE = int(_os.environ.get("LGBM_TPU_MAX_TILE", 32768))
 # scoped-VMEM budget for the partition kernels' staging buffers (the
 # hardware limit is 16 MB; leave headroom for the pipeline's own
 # double-buffered block)
 PART_VMEM_BUDGET = int(_os.environ.get("LGBM_TPU_PART_VMEM", 13_000_000))
 
 
-def partition_vmem_bytes(layout: "PlaneLayout", method: str = "pallas2") -> int:
-    """Scoped-VMEM bytes a partition kernel holds at once: the staging/
-    carry/output buffers all span the full plane count P, so wide-EFB
-    states (hundreds of code planes) can exceed the 16 MB scoped limit
-    at the default 4096-lane tile. Widths are CALIBRATED to compiler-
-    reported scoped allocations (Mosaic multi-buffers the pipeline
-    block on top of the declared scratch): at P=152, S=4096 the
-    compiler reports 21.97 MB for v2 and 18.12 MB for v1 — ~8.8*S and
-    ~7.3*S lane-widths; a margin is added on both."""
-    P, S = layout.num_planes, layout.tile
+def partition_vmem_bytes_at(P: int, S: int, method: str = "pallas2") -> int:
+    """Scoped-VMEM bytes a partition kernel holds at once for plane
+    count P and processing tile S: the staging/carry/output buffers all
+    span the full plane count, so wide-EFB states (hundreds of code
+    planes) can exceed the 16 MB scoped limit. Widths are CALIBRATED to
+    compiler-reported scoped allocations (Mosaic multi-buffers the
+    pipeline block on top of the declared scratch): at P=152, S=4096
+    the compiler reports 21.97 MB for v2 and 18.12 MB for v1 — ~8.8*S
+    and ~7.3*S lane-widths; a margin is added on both."""
     width = 16 * S if method == "pallas2" else 8 * S
     return P * width * 4
+
+
+def partition_vmem_bytes(layout: "PlaneLayout", method: str = "pallas2") -> int:
+    return partition_vmem_bytes_at(layout.num_planes, layout.tile, method)
 
 
 class PlaneLayout(NamedTuple):
@@ -83,8 +91,12 @@ class PlaneLayout(NamedTuple):
     weight: int          # -1 when absent
     num_planes: int      # P, padded to a multiple of 8
     num_rows: int        # true row count n
-    num_lanes: int       # R, n padded to a multiple of tile (+ 1 tile)
+    num_lanes: int       # R, n padded to a multiple of max_tile
+                         # (+ 1 max_tile of window-read headroom)
     tile: int
+    max_tile: int        # largest per-branch processing tile the lane
+                         # padding supports (power-of-2 multiple of
+                         # tile, <= MAX_TILE, scaled to the row count)
 
 
 def make_layout(num_cols: int, code_bits: int, n: int,
@@ -113,9 +125,17 @@ def make_layout(num_cols: int, code_bits: int, n: int,
         weight = p
         p += 1
     num_planes = -(-p // 8) * 8
-    num_lanes = (-(-n // tile) + 1) * tile
+    # lane padding sized for the LARGEST per-branch processing tile:
+    # kernels are per-step-overhead bound, so big leaf windows process
+    # in tiles up to MAX_TILE (fused.py _branch_tile) — window reads
+    # clamp to [0, R - S], so R must carry one max_tile of headroom
+    max_tile = tile
+    while max_tile * 2 <= min(MAX_TILE, max(tile, n // 8)):
+        max_tile *= 2
+    num_lanes = (-(-n // max_tile) + 1) * max_tile
     return PlaneLayout(num_cols, code_bits, cp, grad, hess, rowid,
-                       label, score, weight, num_planes, n, num_lanes, tile)
+                       label, score, weight, num_planes, n, num_lanes,
+                       tile, max_tile)
 
 
 def f32_as_i32(x):
@@ -508,16 +528,21 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cap", "layout", "interpret"))
+                   static_argnames=("cap", "layout", "tile", "interpret"))
 def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
-                     rscal, *, cap: int, interpret: bool = False):
+                     rscal, *, cap: int, tile: Optional[int] = None,
+                     interpret: bool = False):
     """Pallas stable window partition. Returns (data', nleft); data' is
-    the SAME buffer, updated in place (input/output aliased)."""
+    the SAME buffer, updated in place (input/output aliased).
+    ``tile`` overrides the processing tile (must divide ``cap``; the
+    kernels are per-step-overhead bound, so callers pass bigger tiles
+    for bigger capacity branches)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     P, R = data.shape
-    S = layout.tile
+    S = tile if tile is not None else layout.tile
+    assert cap % S == 0, (cap, S)
     nt = cap // S + 1
     wl = nt * S
     rs_blk = jnp.clip(jnp.asarray(start, jnp.int32) // S, 0, R // S - nt)
@@ -825,9 +850,10 @@ def _partition_kernel2(scal, data_ref, dout_ref, win_ref, nleft_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cap", "layout", "interpret"))
+                   static_argnames=("cap", "layout", "tile", "interpret"))
 def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
-                      rscal, *, cap: int, interpret: bool = False):
+                      rscal, *, cap: int, tile: Optional[int] = None,
+                      interpret: bool = False):
     """v2 pallas stable window partition (see _partition_kernel2).
     Same contract as partition_pallas: returns (data', nleft) with
     data' the SAME buffer updated in place."""
@@ -835,7 +861,8 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
     from jax.experimental.pallas import tpu as pltpu
 
     P, R = data.shape
-    S = layout.tile
+    S = tile if tile is not None else layout.tile
+    assert cap % S == 0, (cap, S)
     nt = cap // S + 1
     wl = nt * S
     RB0 = wl + S + 256          # R-region anchor inside the scratch
@@ -903,15 +930,15 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
 
 
 def partition_window(data, layout, start, count, rscal, *, cap,
-                     method="auto", interpret=False):
+                     method="auto", tile=None, interpret=False):
     if method == "auto":
         method = "pallas" if jax.default_backend() == "tpu" else "ref"
     if method == "pallas":
         return partition_pallas(data, layout, start, count, rscal,
-                                cap=cap, interpret=interpret)
+                                cap=cap, tile=tile, interpret=interpret)
     if method == "pallas2":
         return partition_pallas2(data, layout, start, count, rscal,
-                                 cap=cap, interpret=interpret)
+                                 cap=cap, tile=tile, interpret=interpret)
     return partition_ref(data, layout, start, count, rscal, cap=cap)
 
 

@@ -382,9 +382,11 @@ def categorical_split_scan(hist: jax.Array, meta: FeatureMeta, cfg: SplitConfig,
         lh_cum = jnp.cumsum(shh, axis=1)
         lc_ok = (lc >= cfg.min_data_in_leaf) & \
                 (lh_cum + K_EPSILON >= cfg.min_sum_hessian_in_leaf)
-        # unroll: the B sequential steps are tiny [F]-vector ops; loop
-        # trip overhead dominated the categorical scan's cost inside
-        # the fused while_loop (round-4 categorical_perf finding)
+        # unroll=64: the B sequential steps are tiny [F]-vector ops;
+        # loop trip overhead dominated the categorical scan's cost
+        # inside the fused while_loop (round-4 categorical_perf), but a
+        # FULL unroll measured WORSE (1.75x vs 1.63x in round 5) — the
+        # larger program defeats other fusion
         _, fires = jax.lax.scan(step, jnp.zeros(f, inc.dtype),
                                 (inc.T, lc_ok.T), unroll=64)
         return fires.T

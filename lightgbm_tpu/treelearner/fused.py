@@ -378,7 +378,7 @@ class FusedSerialGrower:
         factor = int(np.clip(
             int(os.environ.get("LGBM_TPU_LADDER", 4)), 2, 64))
         tile = self.layout.tile
-        top = self.layout.num_lanes - tile
+        top = self.layout.num_lanes - self.layout.max_tile
         self._caps = []
         c = tile * 4
         while c < top:
@@ -409,6 +409,33 @@ class FusedSerialGrower:
                 self._codes_planes_dev = plane.build_codes_planes(
                     jnp.asarray(self.dataset.bins), self.layout)
         return self._codes_planes_dev
+
+    def _branch_tile(self, cap: int) -> int:
+        """Per-branch partition processing tile: the kernels are
+        per-STEP-overhead bound (~4 us/step, scripts/part_micro.py), so
+        larger capacity branches use larger tiles — up to cap/8, the
+        layout's padded max_tile, and the scoped-VMEM budget."""
+        Ly = self.layout
+        s = Ly.tile
+        while (s * 2 <= Ly.max_tile and s * 2 * 8 <= cap
+               and cap % (s * 2) == 0       # window geometry requires it
+               and plane.partition_vmem_bytes_at(
+                   Ly.num_planes, s * 2, self._part_method)
+               <= plane.PART_VMEM_BUDGET):
+            s *= 2
+        return s
+
+    def _branch_hist_rb(self, cap: int) -> int:
+        """Per-branch histogram row-block length (same per-step
+        amortization as _branch_tile; the planar hist kernel's VMEM
+        footprint is small, so only cap/8 and max_tile bound it)."""
+        rb = min(H.PLANAR_RB, self.layout.max_tile)
+        while rb > 1024 and cap % rb:
+            rb //= 2                         # window coverage requires it
+        while (rb * 2 <= min(8192, self.layout.max_tile, cap // 8)
+               and cap % (rb * 2) == 0):
+            rb *= 2
+        return rb
 
     def _switch_by_cap(self, count, branches_of_cap, *args):
         branches = [branches_of_cap(c) for c in self._caps]
@@ -459,12 +486,15 @@ class FusedSerialGrower:
                  else jnp.float32)
 
         def branch(cap):
+            rb_br = self._branch_hist_rb(cap)
+
             def fn(data, start, count):
                 if planar_ok:
                     ghist = H.histogram_planar_pallas(
                         data, start, count, num_bins=nbins,
                         num_cols=Ly.num_cols, code_bits=Ly.code_bits,
-                        grad_plane=Ly.grad, cap=cap, dtype=dtype)
+                        grad_plane=Ly.grad, cap=cap, dtype=dtype,
+                        rows_per_block=rb_br)
                     return self._hist_from_groups(ghist)
                 rs = jnp.clip(jnp.asarray(start, jnp.int32), 0, R - cap)
                 codes, gh = plane.window_rowmajor(data, self.layout, rs,
@@ -489,10 +519,12 @@ class FusedSerialGrower:
                                     cat_bitset=bits)
 
         def branch(cap):
+            tile_br = self._branch_tile(cap)
+
             def fn(data, start, count, rscal):
                 return plane.partition_window(
                     data, self.layout, start, count, rscal, cap=cap,
-                    method=self._part_method)
+                    method=self._part_method, tile=tile_br)
             return fn
 
         data, nleft = self._switch_by_cap(count, branch, data, start, count,

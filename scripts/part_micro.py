@@ -30,14 +30,17 @@ P = 16
 S = int(os.environ.get("PART_TILE", 4096))
 
 
-def device_ms(fn, *args):
-    """Total device-lane ms for one call of fn, from the profiler."""
+def device_ms(fn, x):
+    """Total device-lane ms for one call of fn, from the profiler.
+    The traced call uses a DIFFERENT argument value than the warm-up —
+    the tunnel serves identical-argument executions from a cache
+    (docs/PERF_NOTES.md tunnel hazards)."""
     import jax
-    fn(*args)  # warm/compile outside the trace
+    jax.block_until_ready(fn(x))  # warm/compile + drain before tracing
     tdir = "/tmp/part_micro_trace"
     os.system(f"rm -rf {tdir}")
     with jax.profiler.trace(tdir):
-        out = fn(*args)
+        out = fn(x + 1)
         jax.block_until_ready(out)
     files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
     with gzip.open(files[0], "rt") as fh:
@@ -120,6 +123,101 @@ def kernel_variant(mode: str):
     return jax.jit(f)
 
 
+def kernel_structural(mode: str):
+    """Variants that mimic the PRODUCTION kernel's structure one
+    element at a time: dynamic (scalar-prefetched) input index maps,
+    manual-DMA output with double buffering, and the 2-stream v2 shape.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = ROWS // S
+
+    def body(scal, x_ref, o_ref, stg0, stg1, sems):
+        t = pl.program_id(0)
+        x = x_ref[...]
+        col = jnp.sum(jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (P, S), 0) == 3, x, 0),
+            axis=0, keepdims=True)
+        keep = ((col >> 8) & 0xFF) <= 120
+        ranks = keep.astype(jnp.int32)
+        b = 1
+        while b < S:
+            ranks = ranks + jnp.where(
+                jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) >= b,
+                pltpu.roll(ranks, b, 1), 0)
+            b *= 2
+        sh = jnp.where(keep, jax.lax.broadcasted_iota(
+            jnp.int32, (1, S), 1) - (ranks - 1), 0)
+        comp = x
+        shv = sh
+        b = 1
+        while b < S:
+            moved = pltpu.roll(shv, S - b, 1)
+            m1 = (moved & b) != 0
+            comp = jnp.where(m1, pltpu.roll(comp, S - b, 1), comp)
+            shv = jnp.where(m1, moved - b, shv)
+            b *= 2
+        if mode == "dynidx":
+            o_ref[...] = comp
+            return
+        # manual-DMA double-buffered output, production-style
+        slot = jax.lax.rem(t, 2)
+
+        @pl.when(slot == 0)
+        def _():
+            stg0[...] = comp
+            @pl.when(t > 0)
+            def _():
+                pltpu.make_async_copy(
+                    stg1, o_ref.at[:, pl.ds((t - 1) * S, S)],
+                    sems.at[1]).wait()
+            pltpu.make_async_copy(
+                stg0, o_ref.at[:, pl.ds(t * S, S)], sems.at[0]).start()
+
+        @pl.when(slot == 1)
+        def _():
+            stg1[...] = comp
+            pltpu.make_async_copy(
+                stg0, o_ref.at[:, pl.ds((t - 1) * S, S)], sems.at[0]).wait()
+            pltpu.make_async_copy(
+                stg1, o_ref.at[:, pl.ds(t * S, S)], sems.at[1]).start()
+
+        @pl.when((t == nt - 1) & (slot == 0))
+        def _():
+            pltpu.make_async_copy(
+                stg0, o_ref.at[:, pl.ds(t * S, S)], sems.at[0]).wait()
+
+        @pl.when((t == nt - 1) & (slot == 1))
+        def _():
+            pltpu.make_async_copy(
+                stg1, o_ref.at[:, pl.ds(t * S, S)], sems.at[1]).wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[pl.BlockSpec(
+            (P, S), lambda t, scal: (0, scal[0] + jnp.minimum(t, scal[1])))],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY)
+                   if mode == "dma" else
+                   pl.BlockSpec((P, S), lambda t, scal: (0, t))),
+        scratch_shapes=[
+            pltpu.VMEM((P, S), jnp.int32),
+            pltpu.VMEM((P, S), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    f = pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, ROWS), jnp.int32),
+    )
+    scal = jnp.asarray([0, nt - 1], jnp.int32)
+    return jax.jit(lambda x: f(scal, x))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -135,6 +233,14 @@ def main():
         total = sum(v for k, v in agg.items() if "pallas" in k.lower()
                     or "custom" in k.lower() or "fusion" in k.lower())
         # fall back to the total if names don't match
+        total = total or sum(agg.values())
+        print(f"  {mode:8s}: {total:8.2f} ms = "
+              f"{total * 1e6 / ROWS:.3f} ns/lane")
+    for mode in ("dynidx", "dma"):
+        fn = kernel_structural(mode)
+        agg = device_ms(fn, x)
+        total = sum(v for k, v in agg.items() if "pallas" in k.lower()
+                    or "custom" in k.lower() or "fusion" in k.lower())
         total = total or sum(agg.values())
         print(f"  {mode:8s}: {total:8.2f} ms = "
               f"{total * 1e6 / ROWS:.3f} ns/lane")
