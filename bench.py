@@ -57,6 +57,10 @@ STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "iters_done": 0, "iter_times": [], "test_auc": None,
          "example_auc": None, "predict_us_per_row": None,
          "example_auc_reference": None}
+# obs.MetricsRegistry activated in main() once lightgbm_tpu is imported;
+# emit() appends its per-phase breakdown AFTER the pre-existing keys so
+# the line stays byte-compatible on everything consumers already parse
+REGISTRY = None
 
 
 def emit(partial: bool) -> None:
@@ -111,6 +115,8 @@ def emit(partial: bool) -> None:
         # agree to the 3rd-6th decimal)
         out["example_conf"] = "reference train.conf, 7000 train/500 test"
         out["example_auc_reference_measured"] = 0.831562
+    if REGISTRY is not None:
+        out.update(REGISTRY.bench_fields())
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -191,6 +197,10 @@ def main():
         pass
 
     import lightgbm_tpu as lgb
+
+    global REGISTRY
+    REGISTRY = lgb.obs.MetricsRegistry()
+    lgb.obs.activate(REGISTRY)
 
     # ONE draw of the generating function; the last TEST_ROWS are held
     # out (a different seed would draw different weights — a different

@@ -15,9 +15,10 @@ __version__ = "0.1.0"
 
 from .config import Config
 from .utils import log
+from . import obs
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (early_stopping, print_evaluation, record_evaluation,
-                       reset_parameter)
+                       record_metrics, reset_parameter)
 from .engine import CVBooster, cv, train
 
 __all__ = [
@@ -31,7 +32,9 @@ __all__ = [
     "early_stopping",
     "print_evaluation",
     "record_evaluation",
+    "record_metrics",
     "reset_parameter",
+    "obs",
     "__version__",
 ]
 

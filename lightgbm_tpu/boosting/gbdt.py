@@ -29,6 +29,7 @@ from ..io.binning import BIN_CATEGORICAL
 from ..models.tree import Tree
 from ..objective.functions import ObjectiveFunction
 from ..metric.metrics import Metric
+from ..obs import span as obs_span
 from ..treelearner.serial import SerialTreeGrower
 from ..utils import log
 
@@ -319,7 +320,8 @@ class GBDT:
             for c in range(k):
                 init_scores[c] = self._boost_from_average(c, True)
             if not (self._fused_persist and self._fused is not None):
-                self._boosting()
+                with obs_span("gbdt/boosting (gradients)", phase="boost"):
+                    self._boosting()
         else:
             g = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, self.num_data))
             h = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, self.num_data))
@@ -339,8 +341,10 @@ class GBDT:
         should_continue = False
         for c in range(k):
             if self.class_need_train[c] and self.train_data.num_features > 0:
-                new_tree = self.tree_learner.grow(
-                    self._grad[c], self._hess[c], self._perm, self.bag_data_cnt)
+                with obs_span("gbdt/grow_tree (host loop)", phase="grow"):
+                    new_tree = self.tree_learner.grow(
+                        self._grad[c], self._hess[c], self._perm,
+                        self.bag_data_cnt)
             else:
                 new_tree = Tree(2)
             if new_tree.num_leaves > 1:
@@ -467,6 +471,58 @@ class GBDT:
                     t.batch.stack["n_leaves"][t.index]))
             return int(jax.device_get(t.tree_arrays["n_leaves"]))
         return t.num_leaves
+
+    def telemetry_stats(self) -> Dict[str, float]:
+        """Per-iteration model/memory stats for the obs layer (only
+        called when telemetry is enabled — the PendingTree fetches here
+        cost a device round trip the normal path never pays)."""
+        from ..treelearner.fused import PendingTree
+        k = self.num_tree_per_iteration
+        stats: Dict[str, float] = {}
+        leaves = 0
+        best_gain = 0.0
+        for t in self.models[-k:]:
+            leaves += self._tree_num_leaves(t)
+            try:
+                if isinstance(t, PendingTree) and t._tree is None:
+                    gains = np.asarray(
+                        jax.device_get(t.tree_arrays["split_gain"]))
+                else:
+                    tree = t._tree if isinstance(t, PendingTree) else t
+                    gains = np.asarray(tree.split_gain[:max(
+                        tree.num_leaves - 1, 0)])
+                if gains.size:
+                    best_gain = max(best_gain, float(np.max(gains)))
+            except Exception:
+                pass
+        stats["num_leaves"] = int(leaves)
+        stats["best_gain"] = round(best_gain, 6)
+        gauges = {}
+        bins = getattr(self.train_data, "bins", None)
+        if bins is not None and hasattr(bins, "nbytes"):
+            # bin bundle resident in HBM (uploaded lazily; same size)
+            gauges["hbm_bins_bytes"] = int(bins.nbytes)
+        tl = self.tree_learner
+        if tl is not None and hasattr(tl, "num_features") \
+                and hasattr(tl, "max_num_bin"):
+            gauges["hbm_hist_pool_bytes"] = int(
+                self.config.num_leaves * tl.num_features
+                * tl.max_num_bin * 2 * 4)
+            try:
+                hist_ci = tl._hist_fn.cache_info()
+                part_ci = tl._partition_fn.cache_info()
+                gauges["compile_cache_hits"] = int(hist_ci.hits
+                                                   + part_ci.hits)
+                gauges["compile_cache_misses"] = int(hist_ci.misses
+                                                     + part_ci.misses)
+            except AttributeError:
+                pass
+        from ..obs import active as obs_active
+        reg = obs_active()
+        if reg is not None:
+            for name, v in gauges.items():
+                reg.set_gauge(name, v)
+        return stats
 
     def _trim_degenerate_tail(self) -> int:
         """Delete every trailing iteration whose trees are all single

@@ -99,6 +99,56 @@ def record_evaluation(eval_result: dict) -> Callable:
     return _EvalRecorder(eval_result)
 
 
+class _MetricsRecorder:
+    """Appends one telemetry record per iteration into a user-owned
+    list. When training runs with an active obs registry (metrics_file
+    / profile_dir set, or an explicitly activated MetricsRegistry), the
+    record is the registry's full per-iteration snapshot — the same
+    dict the JSONL sink writes; otherwise a minimal record (iteration,
+    wall-time delta, eval metrics) keeps the shape usable.
+
+    Runs after the engine snapshots the iteration (order 25: between
+    the eval recorder at 20 and early stopping at 30), so the snapshot
+    is available even on the early-stopped final round."""
+
+    order = 25
+
+    def __init__(self, store: list) -> None:
+        self.store = store
+        self._started = False
+        self._t_prev = None
+
+    def __call__(self, env: CallbackEnv) -> None:
+        import time as _time
+        from .obs import active
+        if not self._started:
+            self.store.clear()
+            self._started = True
+        reg = active()
+        rec = reg.last_record if reg is not None else None
+        if rec is not None and rec.get("iteration") == env.iteration:
+            self.store.append(rec)
+            self._t_prev = _time.perf_counter()
+            return
+        now = _time.perf_counter()
+        dt = 0.0 if self._t_prev is None else now - self._t_prev
+        self._t_prev = now
+        self.store.append({
+            "iteration": env.iteration,
+            "t_iter_s": round(dt, 6),
+            "metrics": {f"{e[0]}/{e[1]}": float(e[2])
+                        for e in env.evaluation_result_list or []},
+        })
+
+
+def record_metrics(metrics_result: list) -> Callable:
+    """Callback collecting per-iteration telemetry snapshots (see
+    docs/OBSERVABILITY.md) into ``metrics_result``."""
+    if not isinstance(metrics_result, list):
+        raise TypeError("metrics_result should be a list")
+    return _MetricsRecorder(metrics_result)
+
+
 class _ParamScheduler:
     """Re-applies parameters each iteration from per-key schedules
     (a list indexed by round, or a callable of the round index)."""

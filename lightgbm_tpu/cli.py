@@ -20,11 +20,37 @@ from .config import Config
 from .utils import log
 
 
+# GNU-style observability flags accepted alongside the reference's
+# key=value args: --metrics-out FILE / --profile-dir DIR /
+# --metrics-interval K (both `--flag value` and `--flag=value` forms)
+_FLAG_PARAMS = {
+    "--metrics-out": "metrics_file",
+    "--profile-dir": "profile_dir",
+    "--metrics-interval": "metrics_interval",
+}
+
+
 def parse_args(argv: List[str]) -> Dict[str, str]:
     """key=value args + config= file (reference application.cpp:49-82;
-    Config::KV2Map/Str2Map)."""
+    Config::KV2Map/Str2Map), plus the --metrics-out/--profile-dir
+    observability flags (docs/OBSERVABILITY.md)."""
     params: Dict[str, str] = {}
-    for arg in argv:
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        flag, eq, flag_val = arg.partition("=")
+        if flag in _FLAG_PARAMS:
+            if not eq:
+                if i + 1 >= len(argv):
+                    log.warning("Flag %s expects a value, ignored", flag)
+                    i += 1
+                    continue
+                i += 1
+                flag_val = argv[i]
+            params[_FLAG_PARAMS[flag]] = flag_val.strip()
+            i += 1
+            continue
+        i += 1
         if "=" not in arg:
             log.warning("Unknown argument %s, ignored", arg)
             continue

@@ -119,6 +119,11 @@ _ALIASES: Dict[str, str] = {
     "forced_splits_file": "forcedsplits_filename",
     "forced_splits": "forcedsplits_filename",
     "verbose": "verbosity",
+    # observability
+    "metrics_out": "metrics_file",
+    "metrics_output_file": "metrics_file",
+    "trace_dir": "profile_dir",
+    "time_tag": "timetag",
     # dataset
     "max_bins": "max_bin",
     "subsample_for_bin": "bin_construct_sample_cnt",
@@ -385,6 +390,18 @@ class Config:
     verbosity: int = 1
     snapshot_freq: int = -1
 
+    # --- observability (docs/OBSERVABILITY.md) ---
+    # JSONL sink: one schema-versioned record per boosting iteration
+    metrics_file: str = ""
+    # jax.profiler trace output dir (XProf); spans/step annotations in
+    # the trace line up with the metrics records
+    profile_dir: str = ""
+    # write every k-th iteration record (1 = all)
+    metrics_interval: int = 1
+    # runtime toggle for the utils/timer.py phase table (equivalent to
+    # LGBM_TPU_TIMETAG=1, but per-train and without reimport)
+    timetag: bool = False
+
     # --- dataset ---
     max_bin: int = 255
     max_bin_by_feature: List[int] = field(default_factory=list)
@@ -586,6 +603,7 @@ class Config:
                 log.fatal("pos/neg bagging only supported for binary objective")
         self.num_leaves = max(self.num_leaves, 2)
         self.max_bin = max(self.max_bin, 2)
+        self.metrics_interval = max(self.metrics_interval, 1)
         log.set_verbosity(self.verbosity)
 
     def to_params_string(self) -> str:

@@ -57,6 +57,29 @@ def _ensure_jit_cache() -> None:
         pass
 
 
+def _telemetry_end_iteration(telemetry, booster, iteration: int,
+                             evals) -> None:
+    """Snapshot one iteration into the telemetry session: sync the
+    device stream first (metrics mode only — the disabled path never
+    pays this) so the wall time is honest, then attach model stats and
+    eval metrics."""
+    import jax
+    gbdt = booster._gbdt
+    extra: Dict[str, Any] = {}
+    try:
+        jax.block_until_ready(gbdt.device_score_state())
+    except Exception:
+        pass
+    try:
+        extra.update(gbdt.telemetry_stats())
+    except Exception as exc:
+        log.debug("telemetry_stats failed: %s", exc)
+    if evals:
+        extra["metrics"] = {f"{ds}/{m}": float(v)
+                            for ds, m, v, _ in evals}
+    telemetry.end_iteration(iteration, extra=extra)
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100, valid_sets=None, valid_names=None,
           fobj=None, feval=None, init_model=None, feature_name: str = "auto",
@@ -88,7 +111,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
     from .utils.timer import global_timer
-    if not os.environ.get("LGBM_TPU_TIMETAG"):
+    _timetag = [v for k, v in params.items()
+                if Config.resolve_alias(k) == "timetag"]
+    if _timetag:
+        # explicit per-train toggle wins over env/verbosity
+        from .config import _parse_bool
+        global_timer.set_enabled(_parse_bool(_timetag[0]))
+    elif not os.environ.get("LGBM_TPU_TIMETAG"):
         # reference -DUSE_TIMETAG phase table (common.h:1054): opt-in
         # via the env knob or verbose>=2 (assign BOTH ways so a quiet
         # train after a verbose one stops paying the annotations)
@@ -173,35 +202,49 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
     callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
 
-    for i in range(num_boost_round):
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=i, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=None))
-        with global_timer.scope("boosting iteration (device dispatch)"):
-            finished = booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        with global_timer.scope("metric evaluation"):
-            if valid_contain_train:
-                evaluation_result_list.extend(
-                    (train_data_name, m, v, b)
-                    for _, m, v, b in booster.eval_train(feval))
-            if booster.name_valid_sets:
-                evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    from . import obs
+    telemetry = obs.TelemetrySession.from_config(booster._gbdt.config)
+    if telemetry is not None:
+        telemetry.start()
+    try:
+        for i in range(num_boost_round):
+            if telemetry is not None:
+                telemetry.begin_iteration(i)
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
                                             iteration=i, begin_iteration=0,
                                             end_iteration=num_boost_round,
-                                            evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score
-            break
-        if finished:
-            break
+                                            evaluation_result_list=None))
+            with obs.span("boosting iteration (device dispatch)",
+                          phase="update"):
+                finished = booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            with obs.span("metric evaluation", phase="eval"):
+                if valid_contain_train:
+                    evaluation_result_list.extend(
+                        (train_data_name, m, v, b)
+                        for _, m, v, b in booster.eval_train(feval))
+                if booster.name_valid_sets:
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+            if telemetry is not None:
+                _telemetry_end_iteration(telemetry, booster, i,
+                                         evaluation_result_list)
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i, begin_iteration=0,
+                                                end_iteration=num_boost_round,
+                                                evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score
+                break
+            if finished:
+                break
+    finally:
+        if telemetry is not None:
+            telemetry.close()
 
     # fused path trains blind between periodic stop checks; drop any
     # trailing all-degenerate iterations it may have accumulated

@@ -142,7 +142,9 @@ def allgather_bytes(shard_bufs: np.ndarray, mesh=None) -> np.ndarray:
     def gather(b):
         return jax.lax.all_gather(b[0], "data")
 
-    return np.asarray(gather(dev))
+    from ..network import collective_span
+    with collective_span("allgather", int(dev.nbytes)):
+        return np.asarray(gather(dev))
 
 
 def construct_bin_mappers_distributed(

@@ -21,10 +21,36 @@ manager jax.distributed auto-detects, and leave machines empty.
 """
 from __future__ import annotations
 
+import contextlib
 import socket
+import time
 from typing import List, Optional, Sequence
 
 from .utils import log
+
+
+@contextlib.contextmanager
+def collective_span(op: str, nbytes: int = 0):
+    """Host-side accounting for one collective dispatch (psum /
+    all_gather / ...). The ops themselves run inside jitted shard_map
+    code where Python cannot observe them, so call sites wrap the
+    DISPATCH and pass a computed byte estimate. Records per-op call
+    count, bytes, and host-visible latency into the active
+    MetricsRegistry; free when no registry is active.
+    """
+    from .obs import registry as _registry
+    reg = _registry.active()
+    if reg is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        reg.record_collective(op, nbytes, dt)
+        log.trace("collective %s: %d bytes, %.3f ms host", op, nbytes,
+                  dt * 1e3)
 
 
 def parse_machine_list(machines: str) -> List[str]:

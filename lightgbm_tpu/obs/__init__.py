@@ -1,0 +1,100 @@
+"""Unified training telemetry (docs/OBSERVABILITY.md).
+
+Layers:
+
+- `MetricsRegistry` (obs/registry.py): counters / gauges / histograms
+  + per-iteration snapshots; one process-global active registry that
+  instrumentation reads with a single `is None` check.
+- `span` / `instrument_kernel` / `step_span` (obs/spans.py): scopes
+  that feed the utils/timer.py table, the registry, and
+  jax.profiler trace annotations at once.
+- `JsonlSink` + schema validators (obs/sink.py).
+- `TelemetrySession` (below): ties registry + sink + profiler to the
+  engine loop, configured from `Config` (`metrics_file`,
+  `profile_dir`, `metrics_interval`).
+
+Everything is off by default: with no active registry, no timer, and
+no profile dir, the instrumentation fast paths reduce to a global
+load per call.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .registry import MetricsRegistry, activate, active, deactivate
+from .sink import (SCHEMA_VERSION, JsonlSink, read_jsonl,
+                   validate_bench_record, validate_record)
+from .spans import (instrument_kernel, span, start_profiler, step_span,
+                    stop_profiler)
+
+__all__ = [
+    "MetricsRegistry", "activate", "active", "deactivate",
+    "SCHEMA_VERSION", "JsonlSink", "read_jsonl", "validate_record",
+    "validate_bench_record", "span", "step_span", "instrument_kernel",
+    "start_profiler", "stop_profiler", "TelemetrySession",
+]
+
+
+class TelemetrySession:
+    """Per-train() telemetry: activates a registry, opens the JSONL
+    sink, optionally starts a jax.profiler trace, and snapshots every
+    iteration. Built by the engine when the Config enables any of it;
+    `from_config` returns None otherwise so the disabled path costs
+    nothing."""
+
+    def __init__(self, metrics_file: str = "", profile_dir: str = "",
+                 interval: int = 1,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.sink = JsonlSink(metrics_file) if metrics_file else None
+        self.interval = max(1, int(interval))
+        self.profile_dir = profile_dir
+        self._step = None
+        self._started = False
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> Optional["TelemetrySession"]:
+        metrics_file = getattr(cfg, "metrics_file", "") or ""
+        profile_dir = getattr(cfg, "profile_dir", "") or ""
+        if not metrics_file and not profile_dir:
+            return None
+        return cls(metrics_file, profile_dir,
+                   getattr(cfg, "metrics_interval", 1))
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        activate(self.registry)
+        if self.profile_dir:
+            start_profiler(self.profile_dir)
+        self._started = True
+
+    def begin_iteration(self, iteration: int) -> None:
+        self._exit_step()
+        self._step = step_span(iteration)
+        self._step.__enter__()
+        self.registry.begin_iteration(iteration)
+
+    def end_iteration(self, iteration: int,
+                      extra: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        rec = self.registry.end_iteration(extra=extra)
+        self._exit_step()
+        if self.sink is not None and iteration % self.interval == 0:
+            self.sink.write(rec)
+        return rec
+
+    def close(self) -> None:
+        self._exit_step()
+        if self.profile_dir:
+            stop_profiler()
+        if self.sink is not None:
+            self.sink.close()
+        deactivate(self.registry)
+        self._started = False
+
+    def _exit_step(self) -> None:
+        if self._step is not None:
+            self._step.__exit__(None, None, None)
+            self._step = None

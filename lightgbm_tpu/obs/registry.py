@@ -1,0 +1,170 @@
+"""Metrics registry: counters / gauges / histograms + per-iteration
+snapshots.
+
+The registry is the single host-side accumulation point for the
+observability layer (docs/OBSERVABILITY.md): kernel wrappers
+(`obs.spans.instrument_kernel`), collective accounting
+(`network.collective_span`), and the training loop all write here, and
+the per-iteration snapshot is what the JSONL sink serializes.
+
+Semantics:
+
+- counters are cumulative over the registry's lifetime (monotone),
+- gauges are last-write-wins point samples,
+- histograms accumulate per ITERATION (reset at `begin_iteration`) and
+  snapshot as {count, sum, min, max},
+- phase times (`add_time`) are cumulative like counters; the snapshot
+  reports the per-iteration DELTA of the three core tree phases
+  (hist / split / partition) plus the residual `t_other_s`, so the four
+  per-phase fields always sum to the iteration wall time exactly.
+
+There is one process-global "active" registry (`activate` / `active`);
+instrumentation call sites read it with a single module-attribute load,
+so a disabled run pays one `is None` check per instrumented call.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+# phases with first-class snapshot fields; everything else shows up in
+# the snapshot's "phases" map only
+CORE_PHASES = ("hist", "split", "partition")
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.times: Dict[str, float] = {}       # phase -> cumulative seconds
+        self._hist: Dict[str, List[float]] = {}  # name -> [cnt, sum, min, max]
+        self.last_record: Optional[Dict[str, Any]] = None
+        self._iteration: Optional[int] = None
+        self._iter_t0 = 0.0
+        self._times_at_begin: Dict[str, float] = {}
+
+    # -- accumulation ---------------------------------------------------
+    def inc(self, name: str, value: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        h = self._hist.get(name)
+        if h is None:
+            self._hist[name] = [1, float(value), float(value), float(value)]
+        else:
+            h[0] += 1
+            h[1] += value
+            h[2] = min(h[2], value)
+            h[3] = max(h[3], value)
+
+    def add_time(self, phase: str, seconds: float) -> None:
+        self.times[phase] = self.times.get(phase, 0.0) + seconds
+
+    def record_collective(self, op: str, nbytes: int, seconds: float) -> None:
+        """One collective dispatch: call count, payload bytes (computed
+        host-side — the op itself runs inside jitted code), host
+        latency."""
+        self.inc(f"collective.{op}.calls")
+        self.inc(f"collective.{op}.bytes", int(nbytes))
+        self.add_time(f"collective.{op}", seconds)
+
+    # -- iteration lifecycle --------------------------------------------
+    def begin_iteration(self, iteration: int,
+                        now: Optional[float] = None) -> None:
+        """`now` is injectable for deterministic tests."""
+        self._iteration = int(iteration)
+        self._iter_t0 = time.perf_counter() if now is None else now
+        self._times_at_begin = dict(self.times)
+        self._hist.clear()
+
+    def end_iteration(self, now: Optional[float] = None,
+                      extra: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """Snapshot the iteration into a schema-versioned record (see
+        obs/sink.py for the schema). Keys are emitted sorted so two
+        registries fed identical operations produce identical records."""
+        from .sink import SCHEMA_VERSION
+        t1 = time.perf_counter() if now is None else now
+        t_iter = max(0.0, t1 - self._iter_t0)
+        deltas = {ph: self.times.get(ph, 0.0)
+                  - self._times_at_begin.get(ph, 0.0)
+                  for ph in CORE_PHASES}
+        core = sum(deltas.values())
+        rec: Dict[str, Any] = {
+            "schema_version": SCHEMA_VERSION,
+            "iteration": self._iteration if self._iteration is not None
+            else -1,
+            "t_iter_s": round(t_iter, 6),
+            "t_hist_s": round(deltas["hist"], 6),
+            "t_split_s": round(deltas["split"], 6),
+            "t_partition_s": round(deltas["partition"], 6),
+            "t_other_s": round(max(0.0, t_iter - core), 6),
+            "counters": {k: self.counters[k] for k in sorted(self.counters)},
+            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+        }
+        if self.times:
+            rec["phases"] = {k: round(self.times[k], 6)
+                             for k in sorted(self.times)}
+        if self._hist:
+            rec["hists"] = {
+                k: {"count": int(h[0]), "sum": round(h[1], 6),
+                    "min": round(h[2], 6), "max": round(h[3], 6)}
+                for k, h in sorted(self._hist.items())}
+        if extra:
+            rec.update(extra)
+        self.last_record = rec
+        self._iteration = None
+        return rec
+
+    # -- exports --------------------------------------------------------
+    def bench_fields(self) -> Dict[str, Any]:
+        """Per-phase breakdown for the bench.py summary line: the three
+        core phase totals always (schema-stable), every other recorded
+        phase and collective counter when nonzero. Keys never collide
+        with the pre-existing bench keys."""
+        out: Dict[str, Any] = {}
+        for ph in CORE_PHASES:
+            out[f"phase_{ph}_s"] = round(self.times.get(ph, 0.0), 3)
+        for ph in sorted(self.times):
+            if ph in CORE_PHASES or ph.startswith("collective."):
+                continue
+            if self.times[ph] > 0:
+                out[f"phase_{ph}_s"] = round(self.times[ph], 3)
+        for key in sorted(self.counters):
+            if key.startswith(("collective.", "kernel.")):
+                v = self.counters[key]
+                out[key.replace(".", "_")] = int(v) if v == int(v) else v
+        return out
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.times.clear()
+        self._hist.clear()
+        self.last_record = None
+        self._iteration = None
+
+
+# -- process-global active registry -------------------------------------
+_ACTIVE: Optional[MetricsRegistry] = None
+
+
+def activate(reg: MetricsRegistry) -> MetricsRegistry:
+    global _ACTIVE
+    _ACTIVE = reg
+    return reg
+
+
+def deactivate(reg: Optional[MetricsRegistry] = None) -> None:
+    """Deactivate the active registry (or only `reg`, when given and
+    still active — lets nested sessions unwind safely)."""
+    global _ACTIVE
+    if reg is None or _ACTIVE is reg:
+        _ACTIVE = None
+
+
+def active() -> Optional[MetricsRegistry]:
+    return _ACTIVE

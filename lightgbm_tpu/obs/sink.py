@@ -1,0 +1,154 @@
+"""Schema-versioned JSONL sink + validators.
+
+One JSON object per line, one line per (sampled) boosting iteration.
+The schema is additive-only within a version: consumers must tolerate
+unknown keys; removing or retyping a key bumps SCHEMA_VERSION.
+
+Iteration record (v1):
+
+  required: schema_version (int), iteration (int >= 0), t_iter_s,
+            t_hist_s, t_split_s, t_partition_s, t_other_s (numbers,
+            >= 0; the four phase fields sum to t_iter_s),
+            counters (object of numbers), gauges (object of numbers)
+  optional: phases (object: cumulative seconds per phase),
+            hists (object: {count, sum, min, max}),
+            metrics (object: "<dataset>/<metric>" -> number),
+            num_leaves (int), best_gain (number)
+
+`validate_bench_record` covers the bench.py summary line (BENCH_*.json
+driver artifacts wrap it under a "parsed" key).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+_REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
+                 "t_other_s")
+_BENCH_REQUIRED = {"metric": str, "value": (int, float), "unit": str,
+                   "vs_baseline": (int, float)}
+_BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
+                       "iters", "test_auc", "test_auc_bayes_ceiling",
+                       "predict_us_per_row", "example_auc",
+                       "example_auc_reference_measured")
+
+
+def _num_map_problems(rec: Dict[str, Any], key: str,
+                      required: bool) -> List[str]:
+    if key not in rec:
+        return [f"missing {key!r}"] if required else []
+    v = rec[key]
+    if not isinstance(v, dict):
+        return [f"{key!r} must be an object, got {type(v).__name__}"]
+    return [f"{key}[{k!r}] must be a number"
+            for k, x in v.items()
+            if not isinstance(x, (int, float)) or isinstance(x, bool)]
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Problems with one iteration record ([] = valid)."""
+    if not isinstance(rec, dict):
+        return ["record must be a JSON object"]
+    problems: List[str] = []
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int):
+        problems.append("missing/non-int 'schema_version'")
+    elif sv > SCHEMA_VERSION:
+        problems.append(f"schema_version {sv} is newer than supported "
+                        f"{SCHEMA_VERSION}")
+    it = rec.get("iteration")
+    if not isinstance(it, int) or isinstance(it, bool) or it < 0:
+        problems.append("'iteration' must be an int >= 0")
+    for key in _REQUIRED_NUM:
+        v = rec.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"'{key}' must be a number")
+        elif v < 0:
+            problems.append(f"'{key}' must be >= 0, got {v}")
+    if not problems:
+        phase_sum = (rec["t_hist_s"] + rec["t_split_s"]
+                     + rec["t_partition_s"] + rec["t_other_s"])
+        # the residual construction makes these equal; 10% tolerance
+        # admits records produced by external tools that measured the
+        # phases independently
+        if phase_sum > rec["t_iter_s"] * 1.1 + 1e-6:
+            problems.append(
+                f"phase times sum to {phase_sum:.6f}s > 110% of "
+                f"t_iter_s={rec['t_iter_s']:.6f}s")
+    problems += _num_map_problems(rec, "counters", required=True)
+    problems += _num_map_problems(rec, "gauges", required=True)
+    problems += _num_map_problems(rec, "phases", required=False)
+    problems += _num_map_problems(rec, "metrics", required=False)
+    if "hists" in rec:
+        if not isinstance(rec["hists"], dict):
+            problems.append("'hists' must be an object")
+        else:
+            for k, h in rec["hists"].items():
+                if not isinstance(h, dict) or \
+                        not all(isinstance(h.get(f), (int, float))
+                                for f in ("count", "sum", "min", "max")):
+                    problems.append(f"hists[{k!r}] must have numeric "
+                                    "count/sum/min/max")
+    return problems
+
+
+def validate_bench_record(rec: Any) -> List[str]:
+    """Problems with one bench.py summary line ([] = valid). Driver
+    artifacts (BENCH_*.json) wrap the line under "parsed"."""
+    if isinstance(rec, dict) and "parsed" in rec:
+        if rec["parsed"] is None:
+            # wrapper for a run that produced no summary line (rc/tail
+            # describe the failure) — nothing to validate
+            return []
+        rec = rec["parsed"]
+    if not isinstance(rec, dict):
+        return ["bench record must be a JSON object"]
+    problems = []
+    for key, tp in _BENCH_REQUIRED.items():
+        if key not in rec:
+            # the nothing-completed emergency line carries only
+            # metric/value/unit/vs_baseline — all four ARE required
+            problems.append(f"missing {key!r}")
+        elif not isinstance(rec[key], tp) or isinstance(rec[key], bool):
+            problems.append(f"{key!r} must be {tp}")
+    for key in _BENCH_OPTIONAL_NUM:
+        if key in rec and (not isinstance(rec[key], (int, float))
+                           or isinstance(rec[key], bool)):
+            problems.append(f"{key!r} must be a number")
+    for key, v in (rec.items() if isinstance(rec, dict) else ()):
+        if key.startswith("phase_") and (not isinstance(v, (int, float))
+                                         or isinstance(v, bool)):
+            problems.append(f"{key!r} must be a number")
+    return problems
+
+
+class JsonlSink:
+    """Append-mode JSONL writer, flushed per line so a killed run keeps
+    every completed iteration."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fh = open(path, "w")
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,135 @@
+"""span(): one scope, three consumers.
+
+A `span` feeds (a) the `utils/timer.py` global table — same names, so
+the LGBM_TPU_TIMETAG phase table is unchanged, (b) the active
+`MetricsRegistry` phase times when a `phase=` is given, and (c) a
+`jax.profiler.TraceAnnotation` range, so host scopes line up with
+device traces in XProf when `profile_dir` is set. When neither the
+timer nor a registry is enabled, a span is a bare `yield` — no
+annotation, no clock read.
+
+`instrument_kernel` wraps a jitted callable once (at lru-cache build
+time) so every dispatch call site is timed without editing each call;
+the disabled fast path is one global load + one `is None` check.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional, Tuple
+
+from ..utils import timer as _timer
+from . import registry as _registry
+
+
+def _trace_annotation(name: str):
+    try:
+        import jax.profiler
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        return ann
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, phase: Optional[str] = None):
+    reg = _registry.active()
+    gt = _timer.global_timer
+    if reg is None and not gt.enabled:
+        yield
+        return
+    ann = _trace_annotation(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if gt.enabled:
+            gt.acc[name] += dt
+            gt.cnt[name] += 1
+        if reg is not None and phase is not None:
+            reg.add_time(phase, dt)
+        if ann is not None:
+            ann.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def step_span(iteration: int):
+    """StepTraceAnnotation wrapper: marks one boosting iteration as an
+    XProf "step" so the trace viewer groups device activity per
+    iteration, aligned with the JSONL records."""
+    ann = None
+    try:
+        import jax.profiler
+        ann = jax.profiler.StepTraceAnnotation("boosting_iteration",
+                                               step_num=int(iteration))
+        ann.__enter__()
+    except Exception:
+        ann = None
+    try:
+        yield
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+
+
+def instrument_kernel(fn, phase: str, name: Optional[str] = None,
+                      collective: Optional[Tuple[str, int]] = None):
+    """Wrap a (jitted) callable with per-call phase timing + a call
+    counter, and optionally collective accounting (`collective` is
+    (op_name, payload_bytes_per_call) — bytes are computed at wrap
+    time because the op runs inside traced code). Timing is host-side
+    dispatch latency: under async dispatch it covers enqueue, on the
+    synchronous test path it covers the compute too."""
+    label = name or f"kernel/{phase}"
+
+    def wrapper(*args, **kwargs):
+        reg = _registry.active()
+        if reg is None and not _timer.global_timer.enabled:
+            return fn(*args, **kwargs)
+        with span(label, phase=phase):
+            out = fn(*args, **kwargs)
+        if reg is not None:
+            reg.inc(f"kernel.{phase}.calls")
+            if collective is not None:
+                op, nbytes = collective
+                reg.inc(f"collective.{op}.calls")
+                reg.inc(f"collective.{op}.bytes", int(nbytes))
+        return out
+
+    wrapper.__name__ = getattr(fn, "__name__", label)
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+# -- jax.profiler programmatic trace capture ----------------------------
+_PROFILING = False
+
+
+def start_profiler(profile_dir: str) -> bool:
+    global _PROFILING
+    if _PROFILING or not profile_dir:
+        return False
+    try:
+        import jax.profiler
+        jax.profiler.start_trace(profile_dir)
+        _PROFILING = True
+        return True
+    except Exception as exc:
+        from ..utils import log
+        log.warning("profile_dir=%s: could not start jax profiler: %s",
+                    profile_dir, exc)
+        return False
+
+
+def stop_profiler() -> None:
+    global _PROFILING
+    if not _PROFILING:
+        return
+    try:
+        import jax.profiler
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _PROFILING = False

@@ -385,10 +385,16 @@ class FusedSerialGrower:
             self._caps.append(c)
             c *= factor
         self._caps.append(top)
-        self._grow_jit = jax.jit(self._grow_tree,
-                                 static_argnames=("compute_score_update",))
-        self._iter_jit = jax.jit(self._train_iter, donate_argnums=0)
-        self._sync_jit = jax.jit(self._sync_scores)
+        from ..obs import instrument_kernel
+        self._grow_jit = instrument_kernel(
+            jax.jit(self._grow_tree,
+                    static_argnames=("compute_score_update",)),
+            "fused", name="fused/grow_tree")
+        self._iter_jit = instrument_kernel(
+            jax.jit(self._train_iter, donate_argnums=0),
+            "fused", name="fused/train_iter")
+        self._sync_jit = instrument_kernel(
+            jax.jit(self._sync_scores), "fused", name="fused/sync_scores")
 
     # ------------------------------------------------------------------
     def codes_planes(self) -> jax.Array:
@@ -1267,7 +1273,9 @@ class FusedSerialGrower:
                 return d, ta
             return jax.lax.scan(step, data, masks, length=k)
 
-        return jax.jit(run, donate_argnums=0)
+        from ..obs import instrument_kernel
+        return instrument_kernel(jax.jit(run, donate_argnums=0),
+                                 "fused", name=f"fused/train_iters_k{k}")
 
     def train_iters_persistent(self, data, shrinkage, masks):
         """masks: [K, F] stacked per-tree feature masks. Returns

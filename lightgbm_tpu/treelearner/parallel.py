@@ -39,6 +39,8 @@ from jax import shard_map
 from ..config import Config
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
+from ..network import collective_span
+from ..obs import instrument_kernel
 from ..ops import histogram as H
 from ..ops import split as S
 from ..ops.partition import next_capacity
@@ -155,7 +157,11 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 total = hist[0].sum(axis=0)
                 hist = per_feature_hist(hist, efb_hist, total[0], total[1])
             return hist, sg, sh
-        return fn
+        # the psum moves one [F, B, 2] f32 histogram per call
+        return instrument_kernel(
+            fn, "hist", name="data_parallel/leaf_histogram",
+            collective=("hist_psum",
+                        self.num_features * B * 2 * 4))
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn_sharded(self, capacity: int):
@@ -176,7 +182,8 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 default_left, miss_bin, is_cat, cat_bitset, capacity,
                 efb=efb)
             return new_perm[None], lc[None]
-        return fn
+        return instrument_kernel(fn, "partition",
+                                 name="data_parallel/partition_leaf")
 
     # -- grower ---------------------------------------------------------
     def grow(self, grad: jax.Array, hess: jax.Array, perm: jax.Array,
@@ -428,7 +435,13 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             # non-selected features keep zero histograms; the replicated
             # scan will simply not pick them
             return hist_global, sg_true, sh_true
-        return fn
+        # ICI traffic per call: the [F] vote tally + the selected
+        # [<=2k, B, 2] histogram slab (full [F, B, 2] when 2k >= F)
+        k2_est = min(2 * top_k, self.num_features)
+        return instrument_kernel(
+            fn, "hist", name="voting_parallel/leaf_histogram",
+            collective=("voting_psum",
+                        self.num_features * 4 + k2_est * B * 2 * 4))
 
 
 class FeatureParallelTreeGrower(SerialTreeGrower):
@@ -491,6 +504,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
             NamedSharding(self.mesh, P("data")))
         self._iter_mc_jit = None
         self._grow_mc_tree_jit = None
+        # per-tree ICI estimate: one [F, B, 2] f32 child-histogram psum
+        # per split, num_leaves - 1 splits per tree
+        self._tree_psum_bytes = ((config.num_leaves - 1)
+                                 * self.num_features * self.max_num_bin
+                                 * 2 * 4)
 
     # -- sharded state construction ------------------------------------
     def _shard_lane_pad(self, v, fill=0.0, dtype=jnp.float32):
@@ -550,8 +568,10 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 in_specs=(P(None, "data"), P("data"), P(), P(), P()),
                 out_specs=(P(None, "data"), P()))(body)
             self._iter_mc_jit = jax.jit(f, donate_argnums=0)
-        return self._iter_mc_jit(data, self._n_per_shard, mask,
-                                 jnp.float32(shrinkage), jnp.float32(bias))
+        with collective_span("fused_iter_psum", self._tree_psum_bytes):
+            return self._iter_mc_jit(data, self._n_per_shard, mask,
+                                     jnp.float32(shrinkage),
+                                     jnp.float32(bias))
 
     def train_iters_persistent(self, data, shrinkage, masks):
         """K sharded iterations in one dispatch (scan inside shard_map);
@@ -571,8 +591,9 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 in_specs=(P(None, "data"), P("data"), P(), P()),
                 out_specs=(P(None, "data"), P()))(body)
             self._iters_mc_jit_k[k] = jax.jit(f, donate_argnums=0)
-        return self._iters_mc_jit_k[k](data, self._n_per_shard, masks,
-                                       jnp.float32(shrinkage))
+        with collective_span("fused_iter_psum", k * self._tree_psum_bytes):
+            return self._iters_mc_jit_k[k](data, self._n_per_shard, masks,
+                                           jnp.float32(shrinkage))
 
     def _sync_scores(self, data):
         from ..ops import plane
@@ -586,9 +607,10 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 score, mode="drop", unique_indices=True)
             return jax.lax.psum(out, "data")
 
-        return functools.partial(
-            shard_map, mesh=self.mesh, check_vma=False,
-            in_specs=(P(None, "data"),), out_specs=P())(body)(data)
+        with collective_span("scores_psum", n * 4):
+            return functools.partial(
+                shard_map, mesh=self.mesh, check_vma=False,
+                in_specs=(P(None, "data"),), out_specs=P())(body)(data)
 
     # -- sharded per-tree path (bagging / multiclass / custom fobj) -----
     def _bins_row_sharded(self):
@@ -673,9 +695,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
 
         if self._grow_mc_tree_jit is None:
             self._grow_mc_tree_jit = self._grow_mc_jit_build()
-        ta, leaf = self._grow_mc_tree_jit(
-            self._bins_row_sharded(), perm_dev, counts_dev,
-            pad_rows(grad), pad_rows(hess), self.feature_masks_for_tree())
+        with collective_span("fused_tree_psum", self._tree_psum_bytes):
+            ta, leaf = self._grow_mc_tree_jit(
+                self._bins_row_sharded(), perm_dev, counts_dev,
+                pad_rows(grad), pad_rows(hess),
+                self.feature_masks_for_tree())
         leaf_of_row = leaf.reshape(-1)[:n] if compute_score_update else None
         return ta, leaf_of_row
 

@@ -33,6 +33,7 @@ from ..io.binning import BIN_CATEGORICAL
 from ..models.tree import Tree
 from ..ops import histogram as H
 from ..ops import split as S
+from ..obs import instrument_kernel
 from ..ops.partition import next_capacity, partition_leaf
 from ..utils import log
 
@@ -120,7 +121,8 @@ class SerialTreeGrower:
 
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         self._extra_rng = np.random.RandomState(config.extra_seed)
-        self._split_jit = jax.jit(self._split_packed)
+        self._split_jit = instrument_kernel(
+            jax.jit(self._split_packed), "split", name="serial/split_scan")
         self._interaction_sets = _parse_interaction_constraints(
             config.interaction_constraints, dataset)
         self._forced_splits = _load_forced_splits(config.forcedsplits_filename)
@@ -202,7 +204,7 @@ class SerialTreeGrower:
                                      capacity, Bg, method=method)
             total = ghist[0].sum(axis=0)  # every row in exactly one code
             return per_feature_hist(ghist, efb_hist, total[0], total[1])
-        return fn
+        return instrument_kernel(fn, "hist", name="serial/leaf_histogram")
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn(self, capacity: int):
@@ -213,7 +215,7 @@ class SerialTreeGrower:
             return partition_leaf(bins, perm, start, count, feature,
                                   threshold, default_left, miss_bin, is_cat,
                                   cat_bitset, capacity, efb=efb)
-        return fn
+        return instrument_kernel(fn, "partition", name="serial/partition_leaf")
 
     # ------------------------------------------------------------------
     def _feature_mask_tree(self) -> np.ndarray:

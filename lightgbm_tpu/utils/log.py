@@ -34,9 +34,23 @@ def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
 
 def _emit(msg: str) -> None:
     if _callback is not None:
-        _callback(msg + "\n")
-    else:
-        sys.stderr.write(msg + "\n")
+        # a raising user callback must not kill training mid-iteration;
+        # fall back to stderr so the line is not lost
+        try:
+            _callback(msg + "\n")
+            return
+        except Exception as exc:
+            sys.stderr.write(
+                f"[LightGBM-TPU] [Warning] log callback raised {exc!r}; "
+                "falling back to stderr\n")
+    sys.stderr.write(msg + "\n")
+
+
+def trace(msg: str, *args) -> None:
+    """Highest-volume level (verbosity >= 3): per-kernel / per-span
+    detail from the obs layer."""
+    if _verbosity >= 3:
+        _emit("[LightGBM-TPU] [Trace] " + (msg % args if args else msg))
 
 
 def debug(msg: str, *args) -> None:

@@ -11,21 +11,29 @@ from __future__ import annotations
 
 import atexit
 import contextlib
+import functools
 import os
 import time
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, Optional
 
 from . import log
 
-_ENABLED = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
+
+def env_enabled() -> bool:
+    """Current LGBM_TPU_TIMETAG state (read per call, not at import —
+    tests and late os.environ writes see the live value)."""
+    return os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
 
 
 class Timer:
-    def __init__(self) -> None:
+    def __init__(self, enabled: Optional[bool] = None) -> None:
         self.acc: Dict[str, float] = defaultdict(float)
         self.cnt: Dict[str, int] = defaultdict(int)
-        self.enabled = _ENABLED
+        self.enabled = env_enabled() if enabled is None else bool(enabled)
+
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = bool(on)
 
     @contextlib.contextmanager
     def scope(self, name: str):
@@ -66,12 +74,18 @@ global_timer = Timer()
 atexit.register(global_timer.print_at_exit)
 
 
+def set_enabled(on: bool) -> None:
+    """Toggle the global timer at runtime (the
+    `lgb.train(params={"timetag": True})` path — no reimport needed)."""
+    global_timer.set_enabled(on)
+
+
 def function_timer(name: str):
     """Decorator form (reference Common::FunctionTimer)."""
     def deco(fn):
+        @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             with global_timer.scope(name):
                 return fn(*args, **kwargs)
-        wrapper.__name__ = fn.__name__
         return wrapper
     return deco

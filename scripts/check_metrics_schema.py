@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Validate observability artifacts against the telemetry schema.
+
+Two artifact kinds (docs/OBSERVABILITY.md):
+
+- per-iteration metrics JSONL written by `metrics_file=` /
+  `--metrics-out` (one record per line, `obs.sink.validate_record`),
+- bench summary JSON: either the raw one-line output of bench.py or the
+  driver's BENCH_*.json wrapper, which nests the parsed line under a
+  "parsed" key (`obs.sink.validate_bench_record` unwraps it).
+
+Usage:
+    python scripts/check_metrics_schema.py [FILE ...]
+
+With no arguments, validates every BENCH_*.json in the repo root
+(MULTICHIP_*.json is a different artifact — device-count probes, no
+bench record — and is skipped). Exit code 0 = all valid. Also usable
+as a pytest module: tests/test_metrics_schema.py imports `check_file`.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from typing import List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from lightgbm_tpu.obs import validate_bench_record, validate_record  # noqa: E402
+
+
+def _looks_like_bench(rec: dict) -> bool:
+    return "metric" in rec or "parsed" in rec
+
+
+def check_file(path: str) -> List[str]:
+    """All schema violations in one artifact file (empty = valid)."""
+    with open(path) as fh:
+        text = fh.read()
+    if not text.strip():
+        return [f"{path}: empty file"]
+    # bench artifacts (raw bench.py line or the driver's pretty-printed
+    # BENCH_*.json wrapper) are ONE document; metrics files are JSONL
+    try:
+        rec = json.loads(text)
+    except ValueError:
+        rec = None
+    if rec is not None:
+        if not isinstance(rec, dict):
+            return [f"{path}: not a JSON object"]
+        errs = (validate_bench_record(rec) if _looks_like_bench(rec)
+                else validate_record(rec))
+        return [f"{path}: {e}" for e in errs]
+    errors: List[str] = []
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as exc:
+            errors.append(f"{path}:{i + 1}: not JSON: {exc}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i + 1}: not a JSON object")
+            continue
+        errs = (validate_bench_record(rec) if _looks_like_bench(rec)
+                else validate_record(rec))
+        errors.extend(f"{path}:{i + 1}: {e}" for e in errs)
+    return errors
+
+
+def default_targets() -> List[str]:
+    return sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_*.json")))
+
+
+def main(argv: List[str]) -> int:
+    targets = argv or default_targets()
+    if not targets:
+        print("no artifacts to validate")
+        return 0
+    failed: List[Tuple[str, List[str]]] = []
+    for path in targets:
+        errs = check_file(path)
+        if errs:
+            failed.append((path, errs))
+            for e in errs:
+                print(f"FAIL {e}")
+        else:
+            print(f"ok   {path}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

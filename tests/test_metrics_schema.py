@@ -1,0 +1,44 @@
+"""Tier-1 wrapper around scripts/check_metrics_schema.py: every bench
+artifact in the repo root must validate against the telemetry schema
+(docs/OBSERVABILITY.md), and the validator must pass/fail the canonical
+record shapes."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "check_metrics_schema.py")
+_spec = importlib.util.spec_from_file_location("check_metrics_schema",
+                                               _SCRIPT)
+checker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(checker)
+
+
+@pytest.mark.parametrize("path", checker.default_targets()
+                         or [pytest.param(None, marks=pytest.mark.skip(
+                             reason="no BENCH_*.json artifacts"))])
+def test_bench_artifacts_validate(path):
+    assert checker.check_file(path) == []
+
+
+def test_validator_flags_broken_jsonl(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(json.dumps({"schema_version": 1, "iteration": 0,
+                             "t_iter_s": 1.0, "t_hist_s": 5.0,
+                             "t_split_s": 0.0, "t_partition_s": 0.0,
+                             "t_other_s": 0.0, "counters": {},
+                             "gauges": {}}) + "\n")
+    errs = checker.check_file(str(p))
+    assert errs and "110%" in errs[0]
+
+
+def test_validator_accepts_valid_jsonl(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    rec = {"schema_version": 1, "iteration": 3, "t_iter_s": 1.0,
+           "t_hist_s": 0.4, "t_split_s": 0.3, "t_partition_s": 0.2,
+           "t_other_s": 0.1, "counters": {"kernel.hist.calls": 7},
+           "gauges": {"hbm_bins_bytes": 1024}}
+    p.write_text(json.dumps(rec) + "\n" + json.dumps(rec) + "\n")
+    assert checker.check_file(str(p)) == []

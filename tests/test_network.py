@@ -1,12 +1,14 @@
 """Multi-host wiring (lightgbm_tpu/network.py): rank discovery and the
 jax.distributed.initialize seam, tested with an injected initializer —
 no second host needed (the reference had no automated coverage of its
-socket linker either; this is strictly more than it had)."""
+socket linker either; this is strictly more than it had). Also the
+collective accounting seam (`collective_span` -> obs registry)."""
 import numpy as np
 import pytest
 
-from lightgbm_tpu.network import (ensure_distributed, local_addresses,
-                                  parse_machine_list, resolve_rank)
+from lightgbm_tpu.network import (collective_span, ensure_distributed,
+                                  local_addresses, parse_machine_list,
+                                  resolve_rank)
 
 
 def test_parse_machine_list():
@@ -107,3 +109,47 @@ def test_ensure_distributed_multiple_local_entries(monkeypatch):
     assert out is True
     assert calls[0]["process_id"] == 1
     assert calls[0]["coordinator_address"] == "10.8.0.1:12400"
+
+
+# -- collective accounting (docs/OBSERVABILITY.md) ----------------------
+
+def test_collective_span_records_into_active_registry():
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import registry as obs_registry
+
+    # no registry: pure pass-through
+    with collective_span("hist_psum", 4096):
+        pass
+
+    reg = obs.activate(obs.MetricsRegistry())
+    try:
+        with collective_span("hist_psum", 4096):
+            pass
+        with collective_span("hist_psum", 4096):
+            pass
+        assert reg.counters["collective.hist_psum.calls"] == 2
+        assert reg.counters["collective.hist_psum.bytes"] == 8192
+        assert reg.times["collective.hist_psum"] > 0
+    finally:
+        obs_registry.deactivate()
+
+
+def test_distributed_binning_allgather_is_counted():
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import registry as obs_registry
+    from lightgbm_tpu.io.distributed import allgather_bytes
+
+    world = 8   # conftest forces 8 virtual CPU devices
+    bufs = np.zeros((world, 64), np.uint8)
+    for r in range(world):
+        bufs[r] = r
+    reg = obs.activate(obs.MetricsRegistry())
+    try:
+        out = allgather_bytes(bufs)
+    except ImportError as exc:
+        pytest.skip(f"shard_map unavailable in this jax: {exc}")
+    finally:
+        obs_registry.deactivate()
+    np.testing.assert_array_equal(out, bufs)
+    assert reg.counters["collective.allgather.calls"] == 1
+    assert reg.counters["collective.allgather.bytes"] == bufs.nbytes

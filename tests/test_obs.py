@@ -1,0 +1,401 @@
+"""Observability layer: MetricsRegistry semantics, spans, JSONL sink,
+timer/log satellites, and the end-to-end train() telemetry contract
+(docs/OBSERVABILITY.md)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import registry as obs_registry
+from lightgbm_tpu.utils import log, timer
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_registry():
+    """Each test starts and ends with no active registry."""
+    obs_registry.deactivate()
+    yield
+    obs_registry.deactivate()
+
+
+def _train_data(n=400, f=8, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+# -- registry semantics --------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    reg = obs.MetricsRegistry()
+    reg.inc("calls")
+    reg.inc("calls", 2)
+    reg.set_gauge("hbm", 10)
+    reg.set_gauge("hbm", 20)          # last write wins
+    reg.observe("lat", 1.0)
+    reg.observe("lat", 3.0)
+    assert reg.counters["calls"] == 3
+    assert reg.gauges["hbm"] == 20
+    assert reg._hist["lat"] == [2, 4.0, 1.0, 3.0]
+
+    reg.begin_iteration(0, now=0.0)
+    assert reg._hist == {}            # histograms reset per iteration
+    assert reg.counters["calls"] == 3  # counters are cumulative
+
+
+def test_snapshot_determinism_and_phase_residual():
+    def run():
+        reg = obs.MetricsRegistry()
+        reg.begin_iteration(5, now=100.0)
+        reg.add_time("hist", 0.25)
+        reg.add_time("split", 0.125)
+        reg.add_time("partition", 0.0625)
+        reg.add_time("eval", 0.25)
+        reg.inc("kernel.hist.calls", 4)
+        reg.set_gauge("hbm_bins_bytes", 4096)
+        reg.observe("leaf_depth", 3)
+        return reg.end_iteration(now=101.0)
+
+    rec1, rec2 = run(), run()
+    assert json.dumps(rec1, sort_keys=False) == json.dumps(rec2)
+    assert rec1["iteration"] == 5
+    assert rec1["t_iter_s"] == 1.0
+    assert rec1["t_hist_s"] == 0.25
+    assert rec1["t_split_s"] == 0.125
+    assert rec1["t_partition_s"] == 0.0625
+    # residual construction: the four phase fields sum to t_iter exactly
+    assert rec1["t_other_s"] == 1.0 - 0.25 - 0.125 - 0.0625
+    assert rec1["hists"]["leaf_depth"]["count"] == 1
+    assert obs.validate_record(rec1) == []
+
+
+def test_phase_deltas_are_per_iteration():
+    reg = obs.MetricsRegistry()
+    reg.begin_iteration(0, now=0.0)
+    reg.add_time("hist", 0.5)
+    reg.end_iteration(now=1.0)
+    reg.begin_iteration(1, now=1.0)
+    reg.add_time("hist", 0.125)
+    rec = reg.end_iteration(now=2.0)
+    assert rec["t_hist_s"] == 0.125          # delta, not cumulative
+    assert rec["phases"]["hist"] == 0.625    # cumulative view
+
+
+def test_record_collective():
+    reg = obs.MetricsRegistry()
+    reg.record_collective("hist_psum", 1024, 0.01)
+    reg.record_collective("hist_psum", 1024, 0.02)
+    assert reg.counters["collective.hist_psum.calls"] == 2
+    assert reg.counters["collective.hist_psum.bytes"] == 2048
+    assert reg.times["collective.hist_psum"] == pytest.approx(0.03)
+
+
+def test_bench_fields_shape():
+    reg = obs.MetricsRegistry()
+    reg.add_time("hist", 0.5)
+    reg.add_time("eval", 0.25)
+    reg.inc("kernel.hist.calls", 3)
+    reg.record_collective("allgather", 100, 0.001)
+    out = reg.bench_fields()
+    assert out["phase_hist_s"] == 0.5
+    assert out["phase_split_s"] == 0.0       # core phases always present
+    assert out["phase_eval_s"] == 0.25
+    assert out["kernel_hist_calls"] == 3
+    assert out["collective_allgather_bytes"] == 100
+    # no dots in keys (they become JSON keys on the bench line)
+    assert all("." not in k for k in out)
+
+
+# -- sink / validators ---------------------------------------------------
+
+def test_jsonl_round_trip(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = obs.MetricsRegistry()
+    sink = obs.JsonlSink(path)
+    for i in range(3):
+        reg.begin_iteration(i, now=float(i))
+        reg.add_time("hist", 0.1)
+        sink.write(reg.end_iteration(now=float(i) + 0.5))
+    sink.close()
+    back = obs.read_jsonl(path)
+    assert [r["iteration"] for r in back] == [0, 1, 2]
+    for r in back:
+        assert obs.validate_record(r) == []
+        assert r["schema_version"] == obs.SCHEMA_VERSION
+
+
+def test_validate_record_rejects_bad_shapes():
+    assert obs.validate_record([]) != []
+    assert obs.validate_record({}) != []
+    good = {"schema_version": 1, "iteration": 0, "t_iter_s": 1.0,
+            "t_hist_s": 0.0, "t_split_s": 0.0, "t_partition_s": 0.0,
+            "t_other_s": 1.0, "counters": {}, "gauges": {}}
+    assert obs.validate_record(good) == []
+    assert obs.validate_record({**good, "iteration": -1}) != []
+    assert obs.validate_record({**good, "t_hist_s": "x"}) != []
+    assert obs.validate_record({**good, "counters": {"a": "b"}}) != []
+    assert obs.validate_record({**good, "schema_version": 99}) != []
+    # unknown keys are tolerated (additive schema)
+    assert obs.validate_record({**good, "novel_key": {"x": 1}}) == []
+
+
+def test_validate_bench_record():
+    assert obs.validate_bench_record({"metric": "m", "value": 1.0,
+                                      "unit": "s", "vs_baseline": 2.0,
+                                      "phase_hist_s": 0.5}) == []
+    assert obs.validate_bench_record({"parsed": None, "rc": 124}) == []
+    assert obs.validate_bench_record(
+        {"parsed": {"metric": "m", "value": 1.0, "unit": "s",
+                    "vs_baseline": 2.0}}) == []
+    assert obs.validate_bench_record({"value": 1.0}) != []
+    assert obs.validate_bench_record(
+        {"metric": "m", "value": 1.0, "unit": "s", "vs_baseline": 2.0,
+         "phase_hist_s": "oops"}) != []
+
+
+# -- spans ---------------------------------------------------------------
+
+def test_span_nesting_feeds_registry_and_timer():
+    reg = obs.activate(obs.MetricsRegistry())
+    timer.global_timer.reset()
+    timer.set_enabled(True)
+    try:
+        with obs.span("outer", phase="hist"):
+            with obs.span("inner", phase="split"):
+                pass
+    finally:
+        timer.set_enabled(False)
+    assert reg.times["hist"] >= reg.times["split"] > 0
+    assert timer.global_timer.cnt["outer"] == 1
+    assert timer.global_timer.cnt["inner"] == 1
+    timer.global_timer.reset()
+
+
+def test_span_without_registry_or_timer_is_free():
+    timer.set_enabled(False)
+    with obs.span("noop", phase="hist"):
+        pass  # bare yield; nothing recorded anywhere
+    assert "noop" not in timer.global_timer.acc
+
+
+def test_instrument_kernel_counts_and_collectives():
+    calls = []
+
+    def fake_kernel(a, b=1):
+        calls.append((a, b))
+        return a + b
+
+    wrapped = obs.instrument_kernel(fake_kernel, "hist",
+                                    collective=("hist_psum", 512))
+    assert wrapped(1, b=2) == 3          # disabled path: plain call
+    reg = obs.activate(obs.MetricsRegistry())
+    assert wrapped(2, b=3) == 5
+    assert reg.counters["kernel.hist.calls"] == 1
+    assert reg.counters["collective.hist_psum.calls"] == 1
+    assert reg.counters["collective.hist_psum.bytes"] == 512
+    assert reg.times["hist"] > 0
+    assert wrapped.__wrapped__ is fake_kernel
+    assert calls == [(1, 2), (2, 3)]
+
+
+def test_step_span_smoke():
+    with obs.step_span(7):
+        pass  # must not raise with or without a profiler session
+
+
+# -- timer / log satellites ----------------------------------------------
+
+def test_timer_env_reread_on_construction(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_TIMETAG", raising=False)
+    assert timer.Timer().enabled is False
+    monkeypatch.setenv("LGBM_TPU_TIMETAG", "1")
+    assert timer.Timer().enabled is True   # no reimport needed
+    monkeypatch.setenv("LGBM_TPU_TIMETAG", "0")
+    assert timer.Timer().enabled is False
+    assert timer.Timer(enabled=True).enabled is True
+
+
+def test_timer_set_enabled_runtime_toggle():
+    t = timer.Timer(enabled=False)
+    with t.scope("a"):
+        pass
+    assert "a" not in t.acc
+    t.set_enabled(True)
+    with t.scope("a"):
+        pass
+    assert t.cnt["a"] == 1
+
+
+def test_function_timer_preserves_metadata():
+    @timer.function_timer("scope-name")
+    def documented_fn(x):
+        """Docstring survives."""
+        return x * 2
+
+    assert documented_fn.__name__ == "documented_fn"
+    assert documented_fn.__doc__ == "Docstring survives."
+    assert documented_fn(21) == 42
+
+
+def test_train_timetag_param_no_reimport(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_TIMETAG", raising=False)
+    X, y = _train_data(n=200)
+    timer.global_timer.reset()
+    reports = []
+    log.register_log_callback(reports.append)
+    log.set_verbosity(1)
+    try:
+        lgb.train({"objective": "binary", "verbose": 1, "num_leaves": 4,
+                   "timetag": True}, lgb.Dataset(X, label=y),
+                  num_boost_round=2)
+    finally:
+        log.register_log_callback(None)
+    # the param enabled the timer at runtime (no reimport), and the
+    # phase table was reported (train() prints + resets it on the way
+    # out)
+    assert timer.global_timer.enabled
+    assert any("timer table" in r for r in reports)
+    # and timetag=false turns it back off for the next train
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+               "timetag": False}, lgb.Dataset(X, label=y),
+              num_boost_round=1)
+    assert not timer.global_timer.enabled
+    timer.global_timer.reset()
+
+
+def test_log_callback_exception_falls_back(capsys):
+    def bad_callback(msg):
+        raise RuntimeError("boom")
+
+    log.set_verbosity(1)   # earlier trains with verbose=-1 lower it
+    log.register_log_callback(bad_callback)
+    try:
+        log.warning("still delivered")
+    finally:
+        log.register_log_callback(None)
+    err = capsys.readouterr().err
+    assert "still delivered" in err
+    assert "log callback raised" in err
+
+
+def test_log_trace_gated_at_verbosity_3(capsys):
+    log.set_verbosity(2)
+    log.trace("hidden %d", 1)
+    assert capsys.readouterr().err == ""
+    log.set_verbosity(3)
+    try:
+        log.trace("shown %d", 2)
+        assert "[Trace] shown 2" in capsys.readouterr().err
+    finally:
+        log.set_verbosity(1)
+
+
+# -- end-to-end train contract -------------------------------------------
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_train_writes_one_valid_line_per_iteration(tmp_path, fused):
+    X, y = _train_data()
+    path = str(tmp_path / "metrics.jsonl")
+    n_iters = 10
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7,
+               "tpu_fused": fused, "metrics_file": path},
+              lgb.Dataset(X, label=y), num_boost_round=n_iters,
+              valid_sets=[lgb.Dataset(X, label=y)])
+    recs = obs.read_jsonl(path)
+    assert len(recs) == n_iters
+    assert [r["iteration"] for r in recs] == list(range(n_iters))
+    for r in recs:
+        assert obs.validate_record(r) == []
+        phase_sum = (r["t_hist_s"] + r["t_split_s"] + r["t_partition_s"]
+                     + r["t_other_s"])
+        assert phase_sum <= r["t_iter_s"] * 1.1 + 1e-6
+        assert r["gauges"]["hbm_bins_bytes"] > 0
+        assert "num_leaves" in r and r["num_leaves"] <= 7
+        assert "valid_0/binary_logloss" in r["metrics"]
+    # training deactivated its registry on the way out
+    assert obs.active() is None
+    if not fused:
+        # host-loop path: real kernel decomposition
+        assert recs[-1]["counters"]["kernel.hist.calls"] > 0
+        assert recs[-1]["counters"]["kernel.split.calls"] > 0
+
+
+def test_metrics_interval_samples_lines(tmp_path):
+    X, y = _train_data(n=200)
+    path = str(tmp_path / "metrics.jsonl")
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+               "metrics_file": path, "metrics_interval": 3},
+              lgb.Dataset(X, label=y), num_boost_round=7)
+    assert [r["iteration"] for r in obs.read_jsonl(path)] == [0, 3, 6]
+
+
+def test_record_metrics_callback(tmp_path):
+    X, y = _train_data(n=200)
+    store = []
+    path = str(tmp_path / "metrics.jsonl")
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+               "metrics_file": path},
+              lgb.Dataset(X, label=y), num_boost_round=4,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_metrics(store)])
+    assert len(store) == 4
+    assert store == obs.read_jsonl(path)    # same records as the sink
+
+    # without a telemetry session: minimal records, same list contract
+    store2 = []
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4},
+              lgb.Dataset(X, label=y), num_boost_round=3,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_metrics(store2)])
+    assert [r["iteration"] for r in store2] == [0, 1, 2]
+    assert all("valid_0/binary_logloss" in r["metrics"] for r in store2)
+    with pytest.raises(TypeError):
+        lgb.record_metrics({})
+
+
+def test_early_stopping_closes_telemetry(tmp_path):
+    X, y = _train_data()
+    rs = np.random.RandomState(7)
+    Xv = rs.randn(100, X.shape[1]).astype(np.float32)
+    yv = rs.randint(0, 2, 100).astype(np.float32)  # noise: stops early
+    path = str(tmp_path / "metrics.jsonl")
+    lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+               "metrics_file": path},
+              lgb.Dataset(X, label=y), num_boost_round=50,
+              valid_sets=[lgb.Dataset(Xv, label=yv)],
+              callbacks=[lgb.early_stopping(2, verbose=False)])
+    recs = obs.read_jsonl(path)
+    assert 0 < len(recs) < 50            # stopped early, file complete
+    assert obs.active() is None          # session closed on unwind
+    for r in recs:
+        assert obs.validate_record(r) == []
+
+
+def test_config_params_and_aliases():
+    cfg = lgb.Config.from_params({"metrics_out": "/tmp/m.jsonl",
+                                  "trace_dir": "/tmp/prof",
+                                  "metrics_interval": 0})
+    assert cfg.metrics_file == "/tmp/m.jsonl"
+    assert cfg.profile_dir == "/tmp/prof"
+    assert cfg.metrics_interval == 1     # clamped to >= 1
+
+
+def test_cli_metrics_flags():
+    from lightgbm_tpu.cli import parse_args
+    p = parse_args(["task=train", "--metrics-out", "m.jsonl",
+                    "--profile-dir=/tmp/prof", "--metrics-interval", "5",
+                    "data=train.txt"])
+    assert p["metrics_file"] == "m.jsonl"
+    assert p["profile_dir"] == "/tmp/prof"
+    assert p["metrics_interval"] == "5"
+    assert p["task"] == "train"
+    assert p["data"] == "train.txt"
+
+
+def test_telemetry_session_from_config_disabled():
+    cfg = lgb.Config.from_params({})
+    assert obs.TelemetrySession.from_config(cfg) is None
