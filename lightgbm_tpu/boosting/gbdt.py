@@ -114,7 +114,12 @@ class GBDT:
         self._score_dirty = False    # train_score stale vs _fused_state
         reason = fused_reject_reason(config, train_data, objective)
         if reason is None:
-            self._fused = FusedSerialGrower(train_data, config, objective)
+            # canonical row bucket (compile/signature.py): pads the
+            # planar layout so same-bucket datasets share executables
+            from ..compile import bucket_rows
+            self._fused = FusedSerialGrower(
+                train_data, config, objective,
+                num_rows_bucket=bucket_rows(train_data.num_data))
         elif config.tree_learner == "data" and len(jax.devices()) > 1:
             # fused single-dispatch iterations sharded over the device
             # mesh: the persistent path when eligible, the per-tree
@@ -517,6 +522,15 @@ class GBDT:
                                                      + part_ci.misses)
             except AttributeError:
                 pass
+        # AOT compile-manager stats (lightgbm_tpu/compile): executable
+        # cache traffic + compile/serialize seconds as gauges so the
+        # JSONL record always carries the session-cumulative totals
+        try:
+            from ..compile import get_manager
+            for k, v in get_manager().snapshot().items():
+                gauges[f"aot_{k}"] = float(v)
+        except Exception:
+            pass
         from ..obs import active as obs_active
         reg = obs_active()
         if reg is not None:
@@ -608,24 +622,62 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_at_iter(self) -> Dict[str, List[Tuple[str, str, float, bool]]]:
         """All metric values: list of (dataset_name, metric_name, value,
-        bigger_is_better)."""
-        out = []
+        bigger_is_better).
+
+        Metrics with a device reduction (metric/metrics.py eval_device)
+        are reduced ON DEVICE and only their scalars transferred — one
+        batched device_get for the whole eval, instead of an [N]-sized
+        np.asarray per dataset per iteration. Host fallback covers
+        averaged-output models (DART weights need the host divide),
+        multiclass score blocks, and metrics without a device path."""
+        from ..obs import active as obs_active
+        reg = obs_active()
+        out: list = []
+        dev_slots: list = []    # (out index, 0-d device array)
         div = 1.0
         if self.average_output and self.current_iteration > 0:
             div = float(self.current_iteration)
+        use_device = (div == 1.0 and os.environ.get(
+            "LGBM_TPU_DEVICE_EVAL", "1") != "0")
+
+        def eval_set(ds_name, metrics, score):
+            sc_host = None
+            for m in metrics:
+                res = None
+                if use_device and score.shape[0] == 1:
+                    try:
+                        res = m.eval_device(score[0], self.objective)
+                    except Exception as exc:
+                        log.debug("device eval of %s failed (%s); host "
+                                  "fallback", m.name, exc)
+                        res = None
+                if res is not None:
+                    for name, val in res:
+                        out.append([ds_name, name, val,
+                                    m.bigger_is_better])
+                        dev_slots.append((len(out) - 1, val))
+                    continue
+                if sc_host is None:
+                    sc_host = np.asarray(score) / div
+                    if reg is not None:
+                        reg.inc("eval.host_transfer_rows",
+                                int(sc_host.shape[-1]))
+                sc = sc_host[0] if sc_host.shape[0] == 1 else sc_host
+                for name, val in m.eval(sc, self.objective):
+                    out.append([ds_name, name, val, m.bigger_is_better])
+
         if self.metrics:
-            sc = np.asarray(self.get_training_score()) / div
-            for m in self.metrics:
-                for name, val in m.eval(sc[0] if sc.shape[0] == 1 else sc,
-                                        self.objective):
-                    out.append(("training", name, val, m.bigger_is_better))
+            eval_set("training", self.metrics, self.get_training_score())
         for i, ms in enumerate(self.valid_metrics):
-            sc = np.asarray(self.valid_score[i].score) / div
-            for m in ms:
-                for name, val in m.eval(sc[0] if sc.shape[0] == 1 else sc,
-                                        self.objective):
-                    out.append((f"valid_{i}", name, val, m.bigger_is_better))
-        return out
+            eval_set(f"valid_{i}", ms, self.valid_score[i].score)
+        if dev_slots:
+            # ONE transfer for every device-reduced scalar of this eval
+            vals = jax.device_get([v for _, v in dev_slots])
+            for (idx, _), v in zip(dev_slots, vals):
+                out[idx][2] = float(v)
+            if reg is not None:
+                reg.inc("eval.device_scalars", len(dev_slots))
+        return [tuple(t) for t in out]
 
     # ------------------------------------------------------------------
     # prediction (reference gbdt_prediction.cpp + c_api predict paths)
@@ -754,7 +806,9 @@ class GBDT:
         elif self.objective is not None:
             if self._convert_jit is None:
                 conv = self.objective.convert_output
-                self._convert_jit = jax.jit(lambda s: conv(s))
+                from ..compile import get_manager
+                self._convert_jit = get_manager().jit_entry(
+                    "predict/convert_output", jax.jit(lambda s: conv(s)))
             out = np.asarray(self._convert_jit(score.T), dtype=np.float64).T
         else:
             out = np.asarray(score, dtype=np.float64)

@@ -27,7 +27,12 @@ _FLAG_PARAMS = {
     "--metrics-out": "metrics_file",
     "--profile-dir": "profile_dir",
     "--metrics-interval": "metrics_interval",
+    "--conf": "config",
 }
+
+# bare subcommand words accepted as the first argument:
+#   python -m lightgbm_tpu warmup --conf train.conf
+_SUBCOMMANDS = {"train", "predict", "convert_model", "refit", "warmup"}
 
 
 def parse_args(argv: List[str]) -> Dict[str, str]:
@@ -35,6 +40,9 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
     Config::KV2Map/Str2Map), plus the --metrics-out/--profile-dir
     observability flags (docs/OBSERVABILITY.md)."""
     params: Dict[str, str] = {}
+    if argv and argv[0] in _SUBCOMMANDS:
+        params["task"] = argv[0]
+        argv = argv[1:]
     i = 0
     while i < len(argv):
         arg = argv[i]
@@ -160,6 +168,23 @@ def run_refit(config: Config, params: Dict[str, str]) -> None:
     log.info("Finished refit, model saved to %s", config.output_model)
 
 
+def run_warmup_task(config: Config, params: Dict[str, str]) -> None:
+    """AOT warmup: compile + persist every entry the configured training
+    job would need, so the next `task=train` process deserializes instead
+    of compiling (docs/COMPILE_CACHE.md)."""
+    from .compile import run_warmup
+
+    summary = run_warmup(config, params)
+    if summary.get("disabled"):
+        log.warning("AOT warmup is disabled (LGBM_TPU_AOT=0 or "
+                    "serialize_executable unavailable)")
+        return
+    log.info("Warmup compiled %d/%d pending entry specs in %.1fs "
+             "(store: %s)", summary.get("compiled", 0),
+             summary.get("entries", 0), summary.get("seconds", 0.0),
+             summary.get("store_dir", "?"))
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     params = parse_args(argv)
@@ -173,6 +198,8 @@ def main(argv=None) -> int:
             run_convert_model(config, params)
         elif config.task == "refit":
             run_refit(config, params)
+        elif config.task == "warmup":
+            run_warmup_task(config, params)
         else:
             log.fatal("Unknown task %s", config.task)
     except Exception as e:  # mirror main.cpp catch-all

@@ -1,0 +1,355 @@
+"""Process-global AOT compile manager.
+
+The manager owns every jit entry point in the stack. Learners register
+entries instead of calling `jax.jit` ad hoc, which buys three things:
+
+- **Sharing**: entries are deduplicated by compile-signature digest, so
+  a second grower built for a same-bucket dataset dispatches through the
+  first grower's executable — zero retraces, zero recompiles.
+- **Durability**: executables compiled through `.lower().compile()` are
+  serialized into the `ExecutableStore`; later processes deserialize
+  instead of compiling.
+- **Warmup**: each shared entry can carry abstract call specs
+  (ShapeDtypeStruct avals), letting warmup threads compile ahead of the
+  first training iteration (compile/warmup.py).
+
+Dispatch order per (entry, concrete shapes): in-memory executable →
+store deserialize → lower+compile (+ serialize) → plain jit fallback.
+Every transition is counted in `CompileManager.stats` and mirrored to
+the active obs registry under `compile.*` counters and the
+"compile"/"aot_load"/"aot_serialize" phase timers.
+
+Thread-safety: per-key locks serialize duplicate compiles (a warmup
+thread and the training thread asking for the same key compile once); a
+single trace lock serializes `.lower()` calls because entry builders may
+temporarily bind instance state (fused.py `_bind_tables`).
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from ..utils import log
+from . import signature as S
+from .store import CorruptBlobError, ExecutableStore, store_enabled
+
+_FALLBACK = object()  # dispatch marker: this key uses plain jit forever
+
+_MAX_SHARED_ENTRIES = 32   # LRU cap: entries close over growers/datasets
+_MAX_EXECUTABLES = 128
+
+
+def _aot_supported() -> bool:
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+class SharedEntry:
+    """One named jit entry point, shareable across learner instances
+    whose compile signatures match. Calling it dispatches AOT-first."""
+
+    def __init__(self, manager: "CompileManager", name: str,
+                 digest: str, build: Callable[[], Callable]) -> None:
+        self.manager = manager
+        self.name = name
+        self.digest = digest
+        self._build = build
+        self._jfn: Optional[Callable] = None
+        self._key_cache: Dict[Tuple, str] = {}
+        # warmup specs: list of (args_pytree_of_avals, statics_dict)
+        self.specs: List[Tuple[Any, Dict[str, Any]]] = []
+
+    def jit_fn(self) -> Callable:
+        if self._jfn is None:
+            self._jfn = self._build()
+        return self._jfn
+
+    def add_spec(self, args: Any, statics: Optional[Dict[str, Any]] = None
+                 ) -> None:
+        statics = dict(statics or {})
+        key = self.key_for(args, statics)
+        if all(self.key_for(a, s) != key for a, s in self.specs):
+            self.specs.append((args, statics))
+
+    def key_for(self, args: Any, statics: Dict[str, Any]) -> str:
+        ss = S.shape_signature(args, statics)
+        key = self._key_cache.get(ss)
+        if key is None:
+            key = S.cache_key(self.digest, ss)
+            self._key_cache[ss] = key
+        return key
+
+    def __call__(self, *args: Any, **statics: Any) -> Any:
+        mgr = self.manager
+        if not mgr.aot_enabled:
+            return self.jit_fn()(*args, **statics)
+        key = self.key_for(args, statics)
+        exe = mgr.executables.get(key)
+        if exe is None:
+            exe = mgr.acquire(self, key, args, statics)
+        else:
+            mgr.count("cache_hits")
+        if exe is _FALLBACK:
+            return self.jit_fn()(*args, **statics)
+        try:
+            # static args are baked into the compiled executable: call
+            # positionally with the traced args only
+            return exe(*args)
+        except Exception as exc:
+            log.debug("AOT executable %s rejected args (%s); falling back "
+                      "to jit", self.name, exc)
+            mgr.executables[key] = _FALLBACK
+            mgr.count("exec_fallbacks")
+            return self.jit_fn()(*args, **statics)
+
+
+class JitEntry:
+    """Registered plain-jit entry: no AOT dispatch, but recompiles are
+    detected (via the PjitFunction cache size) and counted, so the
+    zero-recompile acceptance check sees every entry in the stack."""
+
+    def __init__(self, manager: "CompileManager", name: str,
+                 jfn: Callable) -> None:
+        self.manager = manager
+        self.name = name
+        self._jfn = jfn
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._jfn, item)
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return self._jfn._cache_size()
+        except Exception:
+            return None
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._jfn(*args, **kwargs)
+        if before is not None:
+            after = self._cache_size()
+            if after is not None and after > before:
+                # first call traces+compiles+runs; attributing the whole
+                # call to compile slightly overcounts by one execution
+                self.manager.count("jit_compiles")
+                self.manager.add_time("compile", time.perf_counter() - t0)
+        return out
+
+
+class CompileManager:
+    def __init__(self) -> None:
+        self.store = ExecutableStore()
+        self.shared: "collections.OrderedDict[str, SharedEntry]" = \
+            collections.OrderedDict()
+        self.executables: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self.stats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        # RLock: _compile holds it across .lower(), whose trace re-enters
+        # it through fused.py _bind_tables on the same thread
+        self._trace_lock = threading.RLock()
+        self._key_locks: Dict[str, threading.Lock] = {}
+        self.aot_enabled = store_enabled() and _aot_supported()
+
+    # -- bookkeeping ----------------------------------------------------
+    def count(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self.stats[name] = self.stats.get(name, 0) + value
+        from .. import obs
+        reg = obs.active()
+        if reg is not None:
+            reg.inc(f"compile.{name}", value)
+
+    def add_time(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            key = f"{phase}_s"
+            self.stats[key] = self.stats.get(key, 0.0) + seconds
+        from .. import obs
+        reg = obs.active()
+        if reg is not None:
+            reg.add_time(phase, seconds)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.stats)
+
+    # -- registration ---------------------------------------------------
+    def shared_entry(self, name: str, sig: Any,
+                     build: Callable[[], Callable]) -> SharedEntry:
+        """The entry for (name, signature), creating it on first use.
+        A pre-existing entry keeps ITS builder: signatures are defined
+        precisely so equal digests trace identical programs."""
+        digest = S.signature_digest(name, sig)
+        with self._lock:
+            entry = self.shared.get(digest)
+            if entry is not None:
+                self.shared.move_to_end(digest)
+                return entry
+            entry = SharedEntry(self, name, digest, build)
+            self.shared[digest] = entry
+            while len(self.shared) > _MAX_SHARED_ENTRIES:
+                self.shared.popitem(last=False)
+            return entry
+
+    def jit_entry(self, name: str, jfn: Callable) -> JitEntry:
+        return JitEntry(self, name, jfn)
+
+    # -- dispatch -------------------------------------------------------
+    def _key_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            lk = self._key_locks.get(key)
+            if lk is None:
+                lk = self._key_locks[key] = threading.Lock()
+            return lk
+
+    def _remember(self, key: str, exe: Any) -> None:
+        with self._lock:
+            self.executables[key] = exe
+            self.executables.move_to_end(key)
+            while len(self.executables) > _MAX_EXECUTABLES:
+                self.executables.popitem(last=False)
+
+    def acquire(self, entry: SharedEntry, key: str, args: Any,
+                statics: Dict[str, Any]) -> Any:
+        """Executable for one concrete call: store load, else compile
+        (+persist), else the fallback marker. `args` may be avals."""
+        with self._key_lock(key):
+            exe = self.executables.get(key)
+            if exe is not None:
+                self.count("cache_hits")
+                return exe
+            exe = self._load_from_store(entry, key)
+            if exe is None:
+                exe = self._compile(entry, key, args, statics)
+            self._remember(key, exe)
+            return exe
+
+    def _load_from_store(self, entry: SharedEntry, key: str) -> Any:
+        try:
+            t0 = time.perf_counter()
+            triple = self.store.load(key)
+            if triple is None:
+                return None
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+            exe = deserialize_and_load(*triple)
+            self.add_time("aot_load", time.perf_counter() - t0)
+            self.count("store_loads")
+            return exe
+        except CorruptBlobError:
+            self.count("store_load_errors")
+            return None
+        except Exception as exc:
+            log.debug("AOT deserialize failed for %s (%s)", entry.name, exc)
+            self.count("store_load_errors")
+            self.store.invalidate(key)
+            return None
+
+    def _compile(self, entry: SharedEntry, key: str, args: Any,
+                 statics: Dict[str, Any]) -> Any:
+        try:
+            from jax.experimental.serialize_executable import serialize
+            t0 = time.perf_counter()
+            with self._trace_lock:
+                lowered = entry.jit_fn().lower(*args, **statics)
+            exe = lowered.compile()
+            self.add_time("compile", time.perf_counter() - t0)
+            self.count("cache_misses")
+            t0 = time.perf_counter()
+            triple = serialize(exe)
+            if self.store.save(key, triple):
+                self.add_time("aot_serialize", time.perf_counter() - t0)
+                self.count("store_saves")
+            return exe
+        except Exception as exc:
+            log.debug("AOT compile failed for %s (%s); using plain jit",
+                      entry.name, exc)
+            self.count("fallbacks")
+            return _FALLBACK
+
+    # -- store preload --------------------------------------------------
+    def preload_keys(self) -> List[str]:
+        """Store keys for the current environment not yet in memory."""
+        if not self.aot_enabled:
+            return []
+        with self._lock:
+            loaded = set(self.executables)
+        return [k for k in self.store.keys() if k not in loaded]
+
+    def preload(self, keys: Optional[List[str]] = None,
+                should_stop: Optional[Callable[[], bool]] = None) -> int:
+        """Deserialize stored executables into memory so the first
+        training call is a pure cache hit. Returns how many loaded."""
+        n = 0
+        for key in (self.preload_keys() if keys is None else keys):
+            if should_stop is not None and should_stop():
+                break
+            with self._key_lock(key):
+                if key in self.executables:
+                    continue
+                exe = self._preload_one(key)
+                if exe is not None:
+                    self._remember(key, exe)
+                    n += 1
+        return n
+
+    def _preload_one(self, key: str) -> Any:
+        try:
+            t0 = time.perf_counter()
+            triple = self.store.load(key)
+            if triple is None:
+                return None
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+            exe = deserialize_and_load(*triple)
+            self.add_time("aot_load", time.perf_counter() - t0)
+            self.count("store_preloads")
+            return exe
+        except Exception:
+            self.count("store_load_errors")
+            self.store.invalidate(key)
+            return None
+
+
+_MANAGER: Optional[CompileManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def get_manager() -> CompileManager:
+    global _MANAGER
+    if _MANAGER is None:
+        with _MANAGER_LOCK:
+            if _MANAGER is None:
+                _MANAGER = CompileManager()
+    return _MANAGER
+
+
+def reset_manager() -> None:
+    """Drop the process-global manager (tests)."""
+    global _MANAGER
+    with _MANAGER_LOCK:
+        _MANAGER = None
+
+
+@atexit.register
+def _drop_executables() -> None:
+    """Destroy loaded executables while the runtime is still healthy.
+
+    XLA:CPU aborts the process ("terminate called without an active
+    exception") when an executable produced by deserialize_and_load is
+    still referenced during interpreter teardown; releasing them from
+    Python-side atexit sequences their destructors before the client's.
+    """
+    mgr = _MANAGER
+    if mgr is not None:
+        with mgr._lock:
+            mgr.executables.clear()

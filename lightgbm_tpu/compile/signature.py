@@ -1,0 +1,153 @@
+"""Compile signatures, cache keys, and canonical shape bucketing.
+
+A *compile signature* reduces (params, dataset statics, topology) to the
+minimal set of values that change the traced program, so one serialized
+executable can serve many datasets. The pieces:
+
+- `bucket_rows(n)`: canonical row buckets. Datasets whose row counts land
+  in the same bucket share every row-shaped executable; the pad rows are
+  masked out by a traced row-count argument inside the kernels.
+- `environment_key()`: (jax version, backend, device kind/count,
+  process count) — anything that invalidates a serialized XLA executable
+  wholesale. The store namespaces its directory by this digest.
+- `signature_digest(name, sig)`: entry-point identity. Two jit entries
+  with equal digests trace byte-identical programs and may share one
+  compiled executable (all dataset-varying arrays are traced arguments).
+- `cache_key(base, shape_sig)`: final per-executable key = entry digest
+  refined by the concrete argument avals.
+
+Env knobs: LGBM_TPU_SHAPE_BUCKETS=0 disables bucketing;
+LGBM_TPU_BUCKET_MIN overrides the row count below which datasets keep
+their exact shape (default 1<<20 — small jobs compile fast anyway and
+padding them wastes proportionally more memory).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+
+# Quarter-power-of-two bucket ladder: successive buckets differ by at
+# most 25%, so padding waste is bounded by 25% of rows while the number
+# of distinct buckets between 1M and 1B rows stays at ~40.
+_BUCKET_SUBSTEPS = 4
+
+_IGNORED_CONFIG_FIELDS = frozenset({
+    # pure I/O, logging, and observability — never traced
+    "data", "valid", "input_model", "output_model", "output_result",
+    "convert_model", "convert_model_language", "initscore_filename",
+    "valid_data_initscores", "forcedsplits_filename", "forcedbins_filename",
+    "save_binary", "snapshot_freq", "header", "label_column",
+    "weight_column", "group_column", "ignore_column", "categorical_feature",
+    "two_round", "machines", "machine_list_filename", "time_out",
+    "verbosity", "metrics_file", "profile_dir", "metrics_interval",
+    "timetag", "tpu_warmup", "extra", "task", "data_random_seed",
+    "output_freq", "metric_freq", "is_provide_training_metric",
+    "eval_at", "num_machines", "local_listen_port",
+})
+
+
+def bucketing_enabled() -> bool:
+    return os.environ.get("LGBM_TPU_SHAPE_BUCKETS", "1") != "0"
+
+
+def bucket_min_rows() -> int:
+    try:
+        return int(os.environ.get("LGBM_TPU_BUCKET_MIN", 1 << 20))
+    except ValueError:
+        return 1 << 20
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest canonical bucket >= n, or n itself below the threshold.
+
+    Buckets are (2**k) * (1 + j/4) for j in 0..3 — each at most 25%
+    above the previous, so the padded-row overhead a dataset pays for
+    executable reuse is bounded by 25%.
+    """
+    lo = bucket_min_rows()
+    if not bucketing_enabled() or n <= lo:
+        return n
+    k = max(int(n - 1).bit_length() - 1, 0)
+    base = 1 << k
+    for j in range(_BUCKET_SUBSTEPS + 1):
+        b = base + (base * j) // _BUCKET_SUBSTEPS
+        if b >= n:
+            return b
+    return base * 2  # unreachable; bit_length guarantees n <= 2*base
+
+
+def _jsonable(v: Any) -> Any:
+    """Canonical JSON-friendly form of a signature component."""
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return repr(v)  # exact round-trip, no 0.1 drift
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(v[k]) for k in sorted(v, key=str)}
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {f.name: _jsonable(getattr(v, f.name))
+                for f in dataclasses.fields(v)}
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return ["aval", list(v.shape), str(v.dtype)]
+    return repr(v)
+
+
+def _digest(obj: Any) -> str:
+    payload = json.dumps(_jsonable(obj), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:20]
+
+
+def config_signature(config: Any) -> Dict[str, Any]:
+    """Trace-relevant view of a Config: every field except pure I/O and
+    observability ones. Over-inclusion only splits the cache; UNDER-
+    inclusion would alias distinct programs, so unknown fields stay in."""
+    out = {}
+    for f in dataclasses.fields(config):
+        if f.name in _IGNORED_CONFIG_FIELDS:
+            continue
+        out[f.name] = _jsonable(getattr(config, f.name))
+    return out
+
+
+def environment_key() -> str:
+    devs = jax.devices()
+    env = {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devs[0].device_kind if devs else "none",
+        "device_count": len(devs),
+        "process_count": jax.process_count(),
+    }
+    return _digest(env)
+
+
+def signature_digest(name: str, sig: Any) -> str:
+    return _digest({"entry": name, "sig": sig})
+
+
+def shape_signature(args: Any, statics: Dict[str, Any]) -> Tuple:
+    """Hashable aval signature of one concrete call: (treedef, leaf
+    shapes/dtypes, sorted statics). Works on arrays and ShapeDtypeStructs
+    alike, so warmup specs and live calls produce the same key."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    leaf_sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            leaf_sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            leaf_sig.append(("py", repr(leaf)))
+    return (str(treedef), tuple(leaf_sig),
+            tuple(sorted((k, _jsonable(v)) for k, v in statics.items())))
+
+
+def cache_key(base_digest: str, shape_sig: Tuple) -> str:
+    h = hashlib.sha256(base_digest.encode())
+    h.update(repr(shape_sig).encode())
+    return h.hexdigest()[:32]

@@ -1,0 +1,117 @@
+"""On-disk store of serialized XLA executables.
+
+Layout: <root>/<environment_key>/<cache_key>.aotx — one pickled payload
+per executable holding the `jax.export`-level serialization triple
+(blob, in_tree, out_tree) produced by
+`jax.experimental.serialize_executable.serialize`. The environment-key
+directory namespaces by (jax version, backend, device kind/count,
+process count), so upgrading jax or moving between CPU/TPU can never
+deserialize a stale executable — it simply looks in a different
+directory. Within a directory, keys already encode the compile
+signature and bucketed shapes (signature.py), so files are immutable:
+invalidation is deletion, never rewrite.
+
+Root: $LGBM_TPU_AOT_CACHE, default ~/.cache/lightgbm_tpu/aot.
+LGBM_TPU_AOT=0 disables the store (and all AOT dispatch) entirely.
+
+Corrupt or undeserializable blobs are deleted and reported through the
+manager's counters; callers fall back to plain jit.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from ..utils import log
+from . import signature as S
+
+_PAYLOAD_VERSION = 1
+
+
+def store_enabled() -> bool:
+    return os.environ.get("LGBM_TPU_AOT", "1") != "0"
+
+
+def default_root() -> str:
+    return os.environ.get(
+        "LGBM_TPU_AOT_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu",
+                     "aot"))
+
+
+class ExecutableStore:
+    """Filesystem store; all methods are best-effort and exception-free
+    (a broken disk must never break training)."""
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root or default_root()
+        self._env_dir: Optional[str] = None
+
+    def env_dir(self) -> str:
+        if self._env_dir is None:
+            self._env_dir = os.path.join(self.root, S.environment_key())
+        return self._env_dir
+
+    def path(self, key: str) -> str:
+        return os.path.join(self.env_dir(), key + ".aotx")
+
+    def keys(self) -> List[str]:
+        try:
+            return sorted(f[:-5] for f in os.listdir(self.env_dir())
+                          if f.endswith(".aotx"))
+        except OSError:
+            return []
+
+    def load(self, key: str) -> Optional[Tuple[bytes, Any, Any]]:
+        """The serialized triple for `key`, or None. Corrupt payloads
+        (unpicklable, wrong version, truncated) are deleted on sight."""
+        path = self.path(key)
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+            if (not isinstance(payload, dict)
+                    or payload.get("v") != _PAYLOAD_VERSION
+                    or payload.get("jax") != jax.__version__):
+                raise ValueError("payload version mismatch")
+            return payload["blob"], payload["in_tree"], payload["out_tree"]
+        except FileNotFoundError:
+            return None
+        except Exception as exc:
+            log.debug("AOT store: dropping corrupt blob %s (%s)", path, exc)
+            self.invalidate(key)
+            raise CorruptBlobError(str(exc)) from exc
+
+    def save(self, key: str, triple: Tuple[bytes, Any, Any]) -> bool:
+        """Atomically persist a serialized triple (tmp file + rename, so
+        a concurrent reader never sees a torn write)."""
+        try:
+            os.makedirs(self.env_dir(), exist_ok=True)
+            payload = {"v": _PAYLOAD_VERSION, "jax": jax.__version__,
+                       "key": key, "blob": triple[0],
+                       "in_tree": triple[1], "out_tree": triple[2]}
+            fd, tmp = tempfile.mkstemp(dir=self.env_dir(), suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self.path(key))
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            return True
+        except Exception as exc:
+            log.debug("AOT store: save failed for %s (%s)", key, exc)
+            return False
+
+    def invalidate(self, key: str) -> None:
+        try:
+            os.unlink(self.path(key))
+        except OSError:
+            pass
+
+
+class CorruptBlobError(RuntimeError):
+    """A stored payload existed but could not be decoded."""

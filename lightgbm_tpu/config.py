@@ -401,6 +401,9 @@ class Config:
     # runtime toggle for the utils/timer.py phase table (equivalent to
     # LGBM_TPU_TIMETAG=1, but per-train and without reimport)
     timetag: bool = False
+    # force background AOT warmup in train() regardless of dataset size
+    # (docs/COMPILE_CACHE.md); LGBM_TPU_WARMUP overrides both ways
+    tpu_warmup: bool = False
 
     # --- dataset ---
     max_bin: int = 255

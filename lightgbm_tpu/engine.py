@@ -90,6 +90,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     """reference engine.py:18."""
     params = copy.deepcopy(params) if params else {}
     _ensure_jit_cache()
+    from .compile import preload_store_async
+    preload_store_async()
     # multi-host process wiring BEFORE any dataset construction, so the
     # distributed bin-mapper allgather and the training mesh see the
     # global device set (reference Application::InitTrain calls
@@ -154,6 +156,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     with global_timer.scope("dataset construction + learner build"):
         booster = Booster(params=params, train_set=train_set)
+    from .compile import background_warmup, warmup_wanted
+    if warmup_wanted(booster._gbdt.config, train_set.num_data()):
+        # compile the registered entry specs on a thread pool while the
+        # caller is still wiring callbacks/valid sets; the first training
+        # iteration then dispatches straight into warm executables
+        background_warmup()
     if predictor_model is not None:
         k = predictor_model._gbdt.num_tree_per_iteration
         from .basic import copy_tree
@@ -381,6 +389,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False):
     """reference engine.py:394."""
     _ensure_jit_cache()
+    from .compile import preload_store_async
+    preload_store_async()
     params = copy.deepcopy(params) if params else {}
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     early_stopping_rounds = _resolve_early_stopping(params, early_stopping_rounds)

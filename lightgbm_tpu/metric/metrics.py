@@ -44,6 +44,40 @@ class Metric:
     def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
         raise NotImplementedError
 
+    # -- device-side evaluation (compile manager entry) ----------------
+    def eval_device(self, score_dev, objective=None):
+        """Reduce the metric ON DEVICE over the device-resident score:
+        list of (name, 0-d device array) — so the eval loop transfers
+        scalars, never the [N] score — or None when this metric has no
+        device path (caller falls back to the host eval)."""
+        return None
+
+    def _label_device(self):
+        import jax.numpy as jnp
+        if getattr(self, "_label_dev", None) is None:
+            self._label_dev = jnp.asarray(self.label, jnp.float32)
+        return self._label_dev
+
+    def _weights_device(self):
+        import jax.numpy as jnp
+        if self.weights is None:
+            return None
+        if getattr(self, "_weights_dev", None) is None:
+            self._weights_dev = jnp.asarray(self.weights, jnp.float32)
+        return self._weights_dev
+
+    def _device_entry(self, suffix, objective, build):
+        """Jit entry for this metric's reduction, shared through the
+        compile manager: a later booster with the same config/objective
+        and a same-shape score reuses the executable."""
+        from ..compile import config_signature, get_manager
+        sig = {"metric": self.name, "variant": suffix,
+               "config": config_signature(self.config),
+               "objective": (type(objective).__name__
+                             if objective is not None else None)}
+        return get_manager().shared_entry(
+            f"eval/{self.name}{suffix}", sig, build)
+
     def _convert(self, score, objective):
         if objective is not None:
             import jax.numpy as jnp
@@ -61,6 +95,10 @@ class Metric:
 
 class _Pointwise(Metric):
     convert = True
+    # jnp twin of `loss`; subclasses with a device path override it as a
+    # method (np ufuncs on traced arrays would force host transfers, so
+    # the numpy `loss` bodies cannot be reused under jit)
+    loss_dev = None
 
     def loss(self, label, score):
         raise NotImplementedError
@@ -68,9 +106,41 @@ class _Pointwise(Metric):
     def finalize(self, avg_loss):
         return avg_loss
 
+    def finalize_dev(self, avg_loss):
+        return avg_loss
+
     def eval(self, score, objective=None):
         p = self._convert(score, objective) if self.convert else np.asarray(score)
         val = self.finalize(self._avg(self.loss(self.label, p)))
+        return [(self.name, val)]
+
+    def eval_device(self, score_dev, objective=None):
+        if self.loss_dev is None or self.label is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+        weighted = self.weights is not None
+        convert = self.convert and objective is not None
+
+        def build():
+            def fn_w(score, label, weight):
+                p = objective.convert_output(score) if convert else score
+                loss = self.loss_dev(label, p)
+                return self.finalize_dev(
+                    jnp.sum(loss * weight) / jnp.sum(weight))
+
+            def fn(score, label):
+                p = objective.convert_output(score) if convert else score
+                return self.finalize_dev(jnp.mean(self.loss_dev(label, p)))
+            return jax.jit(fn_w if weighted else fn)
+
+        entry = self._device_entry("/w" if weighted else "", objective,
+                                   build)
+        if weighted:
+            val = entry(score_dev, self._label_device(),
+                        self._weights_device())
+        else:
+            val = entry(score_dev, self._label_device())
         return [(self.name, val)]
 
 
@@ -80,6 +150,9 @@ class L2Metric(_Pointwise):
     def loss(self, y, p):
         return (p - y) ** 2
 
+    def loss_dev(self, y, p):
+        return (p - y) ** 2
+
 
 class RMSEMetric(L2Metric):
     name = "rmse"
@@ -87,12 +160,20 @@ class RMSEMetric(L2Metric):
     def finalize(self, avg):
         return float(np.sqrt(avg))
 
+    def finalize_dev(self, avg):
+        import jax.numpy as jnp
+        return jnp.sqrt(avg)
+
 
 class L1Metric(_Pointwise):
     name = "l1"
 
     def loss(self, y, p):
         return np.abs(p - y)
+
+    def loss_dev(self, y, p):
+        import jax.numpy as jnp
+        return jnp.abs(p - y)
 
 
 class QuantileMetric(_Pointwise):
@@ -182,6 +263,11 @@ class BinaryLoglossMetric(_Pointwise):
         p = np.clip(p, 1e-15, 1 - 1e-15)
         return np.where(is_pos, -np.log(p), -np.log(1 - p))
 
+    def loss_dev(self, y, p):
+        import jax.numpy as jnp
+        p = jnp.clip(p, 1e-15, 1 - 1e-15)
+        return jnp.where(y > 0, -jnp.log(p), -jnp.log(1 - p))
+
 
 class BinaryErrorMetric(_Pointwise):
     name = "binary_error"
@@ -189,6 +275,10 @@ class BinaryErrorMetric(_Pointwise):
     def loss(self, y, p):
         pred_pos = p > 0.5
         return (pred_pos != (y > 0)).astype(np.float64)
+
+    def loss_dev(self, y, p):
+        import jax.numpy as jnp
+        return ((p > 0.5) != (y > 0)).astype(jnp.float32)
 
 
 class AUCMetric(Metric):
@@ -223,6 +313,49 @@ class AUCMetric(Metric):
             log.warning("AUC: data contains only one class")
             return [(self.name, 1.0)]
         return [(self.name, float(acc / (total_pos * total_neg)))]
+
+    def eval_device(self, score_dev, objective=None):
+        """Device AUC with the same tie-block semantics as the host
+        path (scores are f32 on both sides, so tie blocks agree)."""
+        if self.label is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+        weighted = self.weights is not None
+
+        def build():
+            def fn(score, label, weight):
+                s = score.astype(jnp.float32)
+                y = (label > 0).astype(jnp.float32)
+                order = jnp.argsort(-s)
+                s, y, w = s[order], y[order], weight[order]
+                pos_w, neg_w = y * w, (1.0 - y) * w
+                start = jnp.concatenate(
+                    [jnp.ones(1, jnp.int32),
+                     (s[1:] != s[:-1]).astype(jnp.int32)])
+                block = jnp.cumsum(start) - 1
+                n = s.shape[0]
+                bp = jax.ops.segment_sum(pos_w, block, num_segments=n)
+                bn = jax.ops.segment_sum(neg_w, block, num_segments=n)
+                total_pos, total_neg = jnp.sum(pos_w), jnp.sum(neg_w)
+                cum_neg_after = total_neg - jnp.cumsum(bn)
+                acc = jnp.sum(bp * (cum_neg_after + 0.5 * bn))
+                denom = total_pos * total_neg
+                return jnp.where(denom > 0, acc / denom, 1.0)
+            if weighted:
+                return jax.jit(fn)
+            return jax.jit(
+                lambda score, label: fn(score, label,
+                                        jnp.ones_like(label)))
+
+        entry = self._device_entry("/w" if weighted else "", objective,
+                                   build)
+        if weighted:
+            val = entry(score_dev, self._label_device(),
+                        self._weights_device())
+        else:
+            val = entry(score_dev, self._label_device())
+        return [(self.name, val)]
 
 
 # --- multiclass (multiclass_metric.hpp) -----------------------------------

@@ -115,9 +115,14 @@ class PathForest:
         self.values = jnp.asarray(tabs["values"])
         self.tree_class = jnp.asarray(
             np.arange(self.num_trees, dtype=np.int32) % self.num_classes)
+        from ..compile import get_manager
+        self._raw_scores_jit = get_manager().jit_entry(
+            "pathforest/raw_scores", jax.jit(self._raw_scores_impl))
 
-    @functools.partial(jax.jit, static_argnums=0)
     def raw_scores(self, x: jax.Array) -> jax.Array:
+        return self._raw_scores_jit(x)
+
+    def _raw_scores_impl(self, x: jax.Array) -> jax.Array:
         """[num_classes, N] raw scores; x [N, F] f32 raw features."""
         n, f_in = x.shape
         F = max(self.num_features, 1)

@@ -22,14 +22,15 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from .registry import MetricsRegistry, activate, active, deactivate
-from .sink import (SCHEMA_VERSION, JsonlSink, read_jsonl,
+from .sink import (SCHEMA_MINOR, SCHEMA_VERSION, JsonlSink, read_jsonl,
                    validate_bench_record, validate_record)
 from .spans import (instrument_kernel, span, start_profiler, step_span,
                     stop_profiler)
 
 __all__ = [
     "MetricsRegistry", "activate", "active", "deactivate",
-    "SCHEMA_VERSION", "JsonlSink", "read_jsonl", "validate_record",
+    "SCHEMA_VERSION", "SCHEMA_MINOR", "JsonlSink", "read_jsonl",
+    "validate_record",
     "validate_bench_record", "span", "step_span", "instrument_kernel",
     "start_profiler", "stop_profiler", "TelemetrySession",
 ]

@@ -86,7 +86,7 @@ class MetricsRegistry:
         """Snapshot the iteration into a schema-versioned record (see
         obs/sink.py for the schema). Keys are emitted sorted so two
         registries fed identical operations produce identical records."""
-        from .sink import SCHEMA_VERSION
+        from .sink import SCHEMA_MINOR, SCHEMA_VERSION
         t1 = time.perf_counter() if now is None else now
         t_iter = max(0.0, t1 - self._iter_t0)
         deltas = {ph: self.times.get(ph, 0.0)
@@ -95,6 +95,7 @@ class MetricsRegistry:
         core = sum(deltas.values())
         rec: Dict[str, Any] = {
             "schema_version": SCHEMA_VERSION,
+            "schema_minor": SCHEMA_MINOR,
             "iteration": self._iteration if self._iteration is not None
             else -1,
             "t_iter_s": round(t_iter, 6),
@@ -134,7 +135,8 @@ class MetricsRegistry:
             if self.times[ph] > 0:
                 out[f"phase_{ph}_s"] = round(self.times[ph], 3)
         for key in sorted(self.counters):
-            if key.startswith(("collective.", "kernel.")):
+            if key.startswith(("collective.", "kernel.", "compile.",
+                               "eval.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out

@@ -4,13 +4,19 @@ One JSON object per line, one line per (sampled) boosting iteration.
 The schema is additive-only within a version: consumers must tolerate
 unknown keys; removing or retyping a key bumps SCHEMA_VERSION.
 
-Iteration record (v1):
+Iteration record (v1.1):
 
   required: schema_version (int), iteration (int >= 0), t_iter_s,
             t_hist_s, t_split_s, t_partition_s, t_other_s (numbers,
             >= 0; the four phase fields sum to t_iter_s),
             counters (object of numbers), gauges (object of numbers)
-  optional: phases (object: cumulative seconds per phase),
+  optional: schema_minor (int; additive revision within the version —
+            minor 1 adds the AOT compile-manager fields: "compile.*"
+            cache hit/miss/store counters and "eval.*" device-reduction
+            counters under `counters`, the "compile"/"aot_load"/
+            "aot_serialize" phase timers under `phases`, and "aot_*"
+            manager gauges under `gauges`),
+            phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
             metrics (object: "<dataset>/<metric>" -> number),
             num_leaves (int), best_gain (number)
@@ -24,6 +30,9 @@ import json
 from typing import Any, Dict, List, Optional
 
 SCHEMA_VERSION = 1
+# additive revision within SCHEMA_VERSION (see module docstring); bumped
+# to 1 when the compile-manager counters/timers joined the record
+SCHEMA_MINOR = 1
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -32,7 +41,9 @@ _BENCH_REQUIRED = {"metric": str, "value": (int, float), "unit": str,
 _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "iters", "test_auc", "test_auc_bayes_ceiling",
                        "predict_us_per_row", "example_auc",
-                       "example_auc_reference_measured")
+                       "example_auc_reference_measured",
+                       "warm_start", "aot_cache_hits", "aot_cache_misses",
+                       "aot_store_loads", "aot_compile_s")
 
 
 def _num_map_problems(rec: Dict[str, Any], key: str,
@@ -58,6 +69,9 @@ def validate_record(rec: Any) -> List[str]:
     elif sv > SCHEMA_VERSION:
         problems.append(f"schema_version {sv} is newer than supported "
                         f"{SCHEMA_VERSION}")
+    if "schema_minor" in rec and (not isinstance(rec["schema_minor"], int)
+                                  or isinstance(rec["schema_minor"], bool)):
+        problems.append("'schema_minor' must be an int")
     it = rec.get("iteration")
     if not isinstance(it, int) or isinstance(it, bool) or it < 0:
         problems.append("'iteration' must be an int >= 0")

@@ -100,6 +100,9 @@ def instrument_kernel(fn, phase: str, name: Optional[str] = None,
 
     wrapper.__name__ = getattr(fn, "__name__", label)
     wrapper.__wrapped__ = fn
+    lower = getattr(fn, "lower", None)
+    if lower is not None:       # keep AOT .lower() introspection usable
+        wrapper.lower = lower
     return wrapper
 
 

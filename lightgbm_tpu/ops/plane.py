@@ -241,8 +241,13 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
     extra = [f32_as_i32(lane_pad_f(grad))[None], f32_as_i32(lane_pad_f(hess))[None]]
     if rowid is None:
         rowid = jnp.arange(n, dtype=jnp.int32)
-    rid = jnp.pad(rowid.astype(jnp.int32), (0, R - rowid.shape[0])) \
-        if rowid.shape[0] < R else rowid.astype(jnp.int32)
+    # pad lanes get row ids CONTINUING past the real rows (never 0): a
+    # zero fill would let pad lanes alias row 0 in the sync / leaf
+    # scatters when the layout is row-bucketed above the actual count
+    rid = rowid.astype(jnp.int32)
+    if rowid.shape[0] < R:
+        rid = jnp.concatenate(
+            [rid, jnp.arange(rowid.shape[0], R, dtype=jnp.int32)])
     extra.append(rid[None])
     for idx, val in ((layout.label, label), (layout.score, score),
                      (layout.weight, weight)):
@@ -539,6 +544,8 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
     for bigger capacity branches)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ..utils.compat import pallas_hbm_space
+    _HBM = pallas_hbm_space(pltpu)
 
     P, R = data.shape
     S = tile if tile is not None else layout.tile
@@ -564,8 +571,8 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
             lambda side, t, scal: (0, scal[2] + jnp.clip(t, scal[3],
                                                          scal[4])))],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
+            pl.BlockSpec(memory_space=_HBM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         scratch_shapes=[
@@ -859,6 +866,8 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
     data' the SAME buffer updated in place."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ..utils.compat import pallas_hbm_space
+    _HBM = pallas_hbm_space(pltpu)
 
     P, R = data.shape
     S = tile if tile is not None else layout.tile
@@ -887,8 +896,8 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
             lambda side, t, scal: (0, scal[2] + jnp.where(
                 side == 0, jnp.clip(t, scal[3], scal[4]), scal[3])))],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
+            pl.BlockSpec(memory_space=_HBM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         scratch_shapes=[

@@ -42,6 +42,7 @@ is named by fused_reject_reason and warned about loudly.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import Dict, NamedTuple, Optional
@@ -189,7 +190,8 @@ class FusedSerialGrower:
         return self._bins_dev
 
     def __init__(self, dataset: BinnedDataset, config: Config,
-                 objective=None, num_rows_override=None) -> None:
+                 objective=None, num_rows_override=None,
+                 num_rows_bucket=None) -> None:
         self.dataset = dataset
         self._num_rows_override = num_rows_override
         self.config = config
@@ -238,6 +240,7 @@ class FusedSerialGrower:
         # EFB bundle views (None on dense/trivial datasets)
         self._efb_dev = dataset.device_bundle_tables()
         self._efb_hist = dataset.device_hist_tables()
+        self._tables_cache = None
         self.group_max_bin = dataset.group_max_bins
         # backend dispatch: ops/histogram.hist_method is the ONE shared
         # precision choice for every learner; partition follows suit
@@ -260,8 +263,16 @@ class FusedSerialGrower:
         else:
             self._code_bits = 8 * int(
                 np.dtype(dataset.bins.dtype).itemsize)
-        n = (dataset.num_data if num_rows_override is None
-             else num_rows_override)
+        n_actual = (dataset.num_data if num_rows_override is None
+                    else num_rows_override)
+        # canonical row bucketing (compile/signature.py): the layout is
+        # sized to the bucket so every row-shaped executable is shared
+        # across same-bucket datasets; the real row count rides through
+        # the programs as the traced n_valid / bag-count argument and
+        # pad lanes stay outside every window
+        n = n_actual if num_rows_bucket is None \
+            else max(int(num_rows_bucket), n_actual)
+        self.actual_rows = n_actual
         persist = (objective is not None
                    and getattr(objective, "persistent_aux", None) is not None
                    and objective.persistent_aux() is not None
@@ -325,6 +336,7 @@ class FusedSerialGrower:
         # slot n_leaves). Reference: ForceSplits,
         # serial_tree_learner.cpp:427
         self._forced_sched = None
+        self._forced_sig = None
         if config.forcedsplits_filename:
             from .serial import _load_forced_splits
             forced = _load_forced_splits(config.forcedsplits_filename)
@@ -357,6 +369,9 @@ class FusedSerialGrower:
                 self._forced_sched = (jnp.asarray(arr[:, 0]),
                                       jnp.asarray(arr[:, 1]),
                                       jnp.asarray(arr[:, 2]))
+                # forced splits are closed-over device constants: their
+                # host values must refine the compile signature
+                self._forced_sig = arr.tolist()
 
         # score updates can reuse the partition's leaf assignment only
         # when every scored row is in-bag (no bagging/GOSS/RF); with
@@ -386,15 +401,53 @@ class FusedSerialGrower:
             c *= factor
         self._caps.append(top)
         from ..obs import instrument_kernel
-        self._grow_jit = instrument_kernel(
-            jax.jit(self._grow_tree,
-                    static_argnames=("compute_score_update",)),
-            "fused", name="fused/grow_tree")
-        self._iter_jit = instrument_kernel(
-            jax.jit(self._train_iter, donate_argnums=0),
-            "fused", name="fused/train_iter")
-        self._sync_jit = instrument_kernel(
-            jax.jit(self._sync_scores), "fused", name="fused/sync_scores")
+        # jit entry points go through the AOT compile manager
+        # (lightgbm_tpu/compile): same-signature growers share one
+        # executable, executables persist on disk, and warmup threads
+        # can compile them ahead of the first iteration. The sharded
+        # per-shard growers (num_rows_override set) keep plain jit —
+        # their programs mutate post-init (psum_axis) and run under
+        # shard_map.
+        self._mgr = None
+        if num_rows_override is None:
+            from ..compile import get_manager
+            self._mgr = get_manager()
+        if self._mgr is not None:
+            sig = self._compile_signature()
+            self._grow_entry = self._mgr.shared_entry(
+                "fused/grow_tree", sig,
+                lambda: jax.jit(
+                    self._entry_grow_tree,
+                    static_argnames=("compute_score_update",)))
+            self._iter_entry = self._mgr.shared_entry(
+                "fused/train_iter", sig,
+                lambda: jax.jit(self._entry_train_iter, donate_argnums=1))
+            self._sync_entry = self._mgr.shared_entry(
+                "fused/sync_scores", sig,
+                lambda: jax.jit(self._sync_scores))
+            self._trav_entry = self._mgr.shared_entry(
+                "fused/traverse", sig,
+                lambda: jax.jit(self._entry_traverse))
+            self._grow_jit = instrument_kernel(
+                self._grow_entry, "fused", name="fused/grow_tree")
+            self._iter_jit = instrument_kernel(
+                self._iter_entry, "fused", name="fused/train_iter")
+            self._sync_jit = instrument_kernel(
+                self._sync_entry, "fused", name="fused/sync_scores")
+            self._trav_jit = self._trav_entry
+            self._register_warmup_specs()
+        else:
+            self._grow_jit = instrument_kernel(
+                jax.jit(self._entry_grow_tree,
+                        static_argnames=("compute_score_update",)),
+                "fused", name="fused/grow_tree")
+            self._iter_jit = instrument_kernel(
+                jax.jit(self._entry_train_iter, donate_argnums=1),
+                "fused", name="fused/train_iter")
+            self._sync_jit = instrument_kernel(
+                jax.jit(self._sync_scores), "fused",
+                name="fused/sync_scores")
+            self._trav_jit = jax.jit(self._entry_traverse)
 
     # ------------------------------------------------------------------
     def codes_planes(self) -> jax.Array:
@@ -415,6 +468,141 @@ class FusedSerialGrower:
                 self._codes_planes_dev = plane.build_codes_planes(
                     jnp.asarray(self.dataset.bins), self.layout)
         return self._codes_planes_dev
+
+    # -- AOT compile manager integration -------------------------------
+    def _tables(self) -> Dict:
+        """Dataset-valued lookup tables as ONE pytree, passed as a jit
+        ARGUMENT to every entry point. Closing over them instead would
+        bake each dataset's bin boundaries into the executable, which
+        kills cross-dataset executable sharing (and would silently alias
+        programs if the compile signature missed a value).
+
+        The snapshot is frozen on first use: `_bind_tables` temporarily
+        rebinds the instance attributes to TRACERS while a warmup thread
+        lowers an entry, and a concurrent training-thread call site must
+        never pick those up as call arguments."""
+        t = self._tables_cache
+        if t is None:
+            m = self.meta
+            t = {
+                "meta": {"num_bin": m.num_bin,
+                         "missing_type": m.missing_type,
+                         "default_bin": m.default_bin,
+                         "is_categorical": m.is_categorical,
+                         "monotone": m.monotone, "penalty": m.penalty},
+                "miss": self.feature_miss_bin,
+                "efb": self._efb_dev,
+                "efb_hist": self._efb_hist,
+            }
+            # canonicalize scalar leaves (e.g. the EFB hist_tables' bg
+            # int) to arrays so warmup specs can take avals of every
+            # leaf and live calls produce the identical shape signature
+            t = self._tables_cache = jax.tree_util.tree_map(
+                lambda a: a if isinstance(a, jax.Array) else jnp.asarray(a),
+                t)
+        return t
+
+    @contextlib.contextmanager
+    def _bind_tables(self, tables: Dict):
+        """Swap the instance's table attributes for traced values while
+        an entry point traces. Serialized under the manager's trace lock
+        (re-entrant) so a warmup thread lowering one entry can never
+        race the training thread tracing another on this instance."""
+        from ..compile import get_manager
+        with get_manager()._trace_lock:
+            saved = (self.meta, self.feature_miss_bin, self._efb_dev,
+                     self._efb_hist)
+            m = tables["meta"]
+            self.meta = S.FeatureMeta(
+                num_bin=m["num_bin"], missing_type=m["missing_type"],
+                default_bin=m["default_bin"],
+                is_categorical=m["is_categorical"],
+                monotone=m["monotone"], penalty=m["penalty"],
+                cat_idx=saved[0].cat_idx)
+            self.feature_miss_bin = tables["miss"]
+            self._efb_dev = tables["efb"]
+            self._efb_hist = tables["efb_hist"]
+            try:
+                yield
+            finally:
+                (self.meta, self.feature_miss_bin, self._efb_dev,
+                 self._efb_hist) = saved
+
+    def _compile_signature(self) -> Dict:
+        """Everything that shapes the traced programs EXCEPT the table
+        values (traced args) and row-shaped arrays (in the per-call
+        shape signature). Equal signatures => identical jaxprs."""
+        from ..compile import config_signature
+        return {
+            "config": config_signature(self.config),
+            "layout": tuple(self.layout),
+            "caps": tuple(self._caps),
+            "num_features": self.num_features,
+            "max_num_bin": self.max_num_bin,
+            "group_max_bin": self.group_max_bin,
+            "num_leaves": self.num_leaves,
+            "any_categorical": self.any_categorical,
+            "use_monotone": self.use_monotone,
+            "cat_idx": tuple(self.meta.cat_idx),
+            "hist_method": self._hist_method,
+            "part_method": self._part_method,
+            "use_hist_pool": self._use_hist_pool,
+            "score_from_partition": self._score_from_partition,
+            "persistent": self.persistent_capable,
+            "objective": (type(self.objective).__name__
+                          if self.objective is not None else None),
+            "split_cfg": self.split_cfg,
+            "forced": self._forced_sig,
+            "efb": self._efb_dev is not None,
+            "efb_hist": self._efb_hist is not None,
+        }
+
+    def _entry_grow_tree(self, tables, codes_planes, grad, hess, perm,
+                         bag_cnt, feature_mask, bins_rowmajor=None,
+                         compute_score_update: bool = True):
+        with self._bind_tables(tables):
+            return self._grow_tree(codes_planes, grad, hess, perm,
+                                   bag_cnt, feature_mask, bins_rowmajor,
+                                   compute_score_update)
+
+    def _entry_train_iter(self, tables, data, feature_mask, shrinkage,
+                          bias, n_valid):
+        with self._bind_tables(tables):
+            return self._train_iter(data, feature_mask, shrinkage, bias,
+                                    n_valid=n_valid)
+
+    def _entry_traverse(self, tables, ta, bins):
+        with self._bind_tables(tables):
+            return self.traverse_bins(ta, bins)
+
+    def _register_warmup_specs(self) -> None:
+        """Abstract call specs (ShapeDtypeStructs) for the entries the
+        training loop will hit, so compile/warmup.py can compile them
+        before (or concurrently with) the first iteration."""
+        Ly = self.layout
+        aval = jax.ShapeDtypeStruct
+        t_avals = jax.tree_util.tree_map(
+            lambda a: aval(a.shape, a.dtype), self._tables())
+        data_aval = aval((Ly.num_planes, Ly.num_lanes), jnp.int32)
+        if self.config.feature_fraction_bynode < 1.0:
+            mask_aval = aval((2 * self.num_leaves, self.num_features),
+                             jnp.bool_)
+        else:
+            mask_aval = aval((self.num_features,), jnp.bool_)
+        f32s = aval((), jnp.float32)
+        i32s = aval((), jnp.int32)
+        if self.persistent_capable and self._score_from_partition:
+            self._iter_entry.add_spec(
+                (t_avals, data_aval, mask_aval, f32s, f32s, i32s))
+            self._sync_entry.add_spec((data_aval,))
+        elif self._score_from_partition:
+            n = self.actual_rows
+            cp_aval = aval((Ly.code_planes, Ly.num_lanes), jnp.int32)
+            fvec = aval((n,), jnp.float32)
+            perm_aval = aval((Ly.num_rows,), jnp.int32)
+            self._grow_entry.add_spec(
+                (t_avals, cp_aval, fvec, fvec, perm_aval, i32s, mask_aval,
+                 None), {"compute_score_update": True})
 
     def _branch_tile(self, cap: int) -> int:
         """Per-branch partition processing tile: the kernels are
@@ -1191,9 +1379,15 @@ class FusedSerialGrower:
             cp = plane.build_codes_planes(self.bins[perm_dev], self.layout)
             g, h = grad[perm_dev], hess[perm_dev]
             bins_arg = self.bins
-        return self._grow_jit(cp, g, h, perm_dev, jnp.int32(bag_cnt),
-                              self.feature_masks_for_tree(), bins_arg,
-                              compute_score_update=compute_score_update)
+        ta, leaf = self._grow_jit(self._tables(), cp, g, h, perm_dev,
+                                  jnp.int32(bag_cnt),
+                                  self.feature_masks_for_tree(), bins_arg,
+                                  compute_score_update=compute_score_update)
+        if leaf is not None and leaf.shape[0] != self.actual_rows:
+            # row-bucketed layout: pad lanes scattered into positions
+            # >= actual_rows (build_data's arange rowid continuation)
+            leaf = leaf[:self.actual_rows]
+        return ta, leaf
 
     # -- persistent mode -----------------------------------------------
     def init_persistent_state(self, score_vec) -> jax.Array:
@@ -1257,8 +1451,9 @@ class FusedSerialGrower:
     def train_iter_persistent(self, data, shrinkage, bias, mask=None):
         if mask is None:
             mask = self.feature_masks_for_tree()
-        return self._iter_jit(data, mask, jnp.float32(shrinkage),
-                              jnp.float32(bias))
+        return self._iter_jit(self._tables(), data, mask,
+                              jnp.float32(shrinkage), jnp.float32(bias),
+                              jnp.int32(self.actual_rows))
 
     def _iters_scan_jit_build(self, k: int):
         """K boosting iterations in ONE dispatch: lax.scan over the
@@ -1266,16 +1461,24 @@ class FusedSerialGrower:
         the single-iteration program). Exists because each dispatch over
         the remote-accelerator tunnel costs tens of ms of host latency —
         at K=10 the per-iteration dispatch overhead drops 10x."""
-        def run(data, masks, shrinkage):
-            def step(d, mask):
-                d, ta = self._train_iter(d, mask, shrinkage,
-                                         jnp.float32(0.0))
-                return d, ta
-            return jax.lax.scan(step, data, masks, length=k)
+        def run(tables, data, masks, shrinkage, n_valid):
+            with self._bind_tables(tables):
+                def step(d, mask):
+                    d, ta = self._train_iter(d, mask, shrinkage,
+                                             jnp.float32(0.0),
+                                             n_valid=n_valid)
+                    return d, ta
+                return jax.lax.scan(step, data, masks, length=k)
 
         from ..obs import instrument_kernel
-        return instrument_kernel(jax.jit(run, donate_argnums=0),
-                                 "fused", name=f"fused/train_iters_k{k}")
+        if self._mgr is not None:
+            entry = self._mgr.shared_entry(
+                f"fused/train_iters_k{k}", self._compile_signature(),
+                lambda: jax.jit(run, donate_argnums=1))
+        else:
+            entry = jax.jit(run, donate_argnums=1)
+        return instrument_kernel(entry, "fused",
+                                 name=f"fused/train_iters_k{k}")
 
     def train_iters_persistent(self, data, shrinkage, masks):
         """masks: [K, F] stacked per-tree feature masks. Returns
@@ -1286,7 +1489,9 @@ class FusedSerialGrower:
             self._iters_jit_k = {}
         if k not in self._iters_jit_k:
             self._iters_jit_k[k] = self._iters_scan_jit_build(k)
-        return self._iters_jit_k[k](data, masks, jnp.float32(shrinkage))
+        return self._iters_jit_k[k](self._tables(), data, masks,
+                                    jnp.float32(shrinkage),
+                                    jnp.int32(self.actual_rows))
 
     def _sync_scores(self, data):
         n = self.layout.num_rows
@@ -1298,7 +1503,12 @@ class FusedSerialGrower:
     def sync_scores(self, data) -> jax.Array:
         """[n] f32 raw scores in original row order (one scatter — only
         runs when a host consumer asks)."""
-        return self._sync_jit(data)
+        out = self._sync_jit(data)
+        if self._num_rows_override is None \
+                and out.shape[0] != self.actual_rows:
+            # bucketed layout: pad lanes landed beyond the real rows
+            out = out[:self.actual_rows]
+        return out
 
     # ------------------------------------------------------------------
     def _traverse_device(self, ta) -> jax.Array:
@@ -1393,9 +1603,11 @@ class FusedSerialGrower:
             masks[e, self._col_rng.choice(idx, size=k, replace=False)] = True
         return jnp.asarray(masks)
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _valid_traverse_jit(self, ta, bins):
-        return self.traverse_bins(ta, bins)
+        """Jitted traversal for valid-set score updates; dispatches
+        through the compile manager so same-signature boosters reuse
+        one executable per valid-set shape."""
+        return self._trav_jit(self._tables(), ta, bins)
 
     def materialize_tree(self, tree_arrays: Dict) -> Tree:
         """Device tree arrays → host Tree (real feature ids, real

@@ -34,7 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from ..config import Config
 from ..io.dataset import BinnedDataset
@@ -158,8 +158,11 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 hist = per_feature_hist(hist, efb_hist, total[0], total[1])
             return hist, sg, sh
         # the psum moves one [F, B, 2] f32 histogram per call
+        from ..compile import get_manager
         return instrument_kernel(
-            fn, "hist", name="data_parallel/leaf_histogram",
+            get_manager().jit_entry(
+                f"data_parallel/leaf_histogram_c{capacity}", fn),
+            "hist", name="data_parallel/leaf_histogram",
             collective=("hist_psum",
                         self.num_features * B * 2 * 4))
 
@@ -182,8 +185,11 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 default_left, miss_bin, is_cat, cat_bitset, capacity,
                 efb=efb)
             return new_perm[None], lc[None]
-        return instrument_kernel(fn, "partition",
-                                 name="data_parallel/partition_leaf")
+        from ..compile import get_manager
+        return instrument_kernel(
+            get_manager().jit_entry(
+                f"data_parallel/partition_leaf_c{capacity}", fn),
+            "partition", name="data_parallel/partition_leaf")
 
     # -- grower ---------------------------------------------------------
     def grow(self, grad: jax.Array, hess: jax.Array, perm: jax.Array,
@@ -438,8 +444,11 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
         # ICI traffic per call: the [F] vote tally + the selected
         # [<=2k, B, 2] histogram slab (full [F, B, 2] when 2k >= F)
         k2_est = min(2 * top_k, self.num_features)
+        from ..compile import get_manager
         return instrument_kernel(
-            fn, "hist", name="voting_parallel/leaf_histogram",
+            get_manager().jit_entry(
+                f"voting_parallel/leaf_histogram_c{capacity}", fn),
+            "hist", name="voting_parallel/leaf_histogram",
             collective=("voting_psum",
                         self.num_features * 4 + k2_est * B * 2 * 4))
 
@@ -567,7 +576,9 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 shard_map, mesh=self.mesh, check_vma=False,
                 in_specs=(P(None, "data"), P("data"), P(), P(), P()),
                 out_specs=(P(None, "data"), P()))(body)
-            self._iter_mc_jit = jax.jit(f, donate_argnums=0)
+            from ..compile import get_manager
+            self._iter_mc_jit = get_manager().jit_entry(
+                "mc/train_iter", jax.jit(f, donate_argnums=0))
         with collective_span("fused_iter_psum", self._tree_psum_bytes):
             return self._iter_mc_jit(data, self._n_per_shard, mask,
                                      jnp.float32(shrinkage),
@@ -590,7 +601,9 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 shard_map, mesh=self.mesh, check_vma=False,
                 in_specs=(P(None, "data"), P("data"), P(), P()),
                 out_specs=(P(None, "data"), P()))(body)
-            self._iters_mc_jit_k[k] = jax.jit(f, donate_argnums=0)
+            from ..compile import get_manager
+            self._iters_mc_jit_k[k] = get_manager().jit_entry(
+                f"mc/train_iters_k{k}", jax.jit(f, donate_argnums=0))
         with collective_span("fused_iter_psum", k * self._tree_psum_bytes):
             return self._iters_mc_jit_k[k](data, self._n_per_shard, masks,
                                            jnp.float32(shrinkage))
@@ -676,7 +689,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
             in_specs=(P("data", None, None), P("data", None), P("data"),
                       P("data", None), P("data", None), P()),
             out_specs=(P(), P("data", None)))(body)
-        return jax.jit(f)
+        from ..compile import get_manager
+        return get_manager().jit_entry("mc/grow_tree", jax.jit(f))
 
     def grow_device(self, grad, hess, perm, bag_cnt,
                     compute_score_update=True):

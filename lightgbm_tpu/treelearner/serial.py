@@ -121,8 +121,11 @@ class SerialTreeGrower:
 
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         self._extra_rng = np.random.RandomState(config.extra_seed)
+        from ..compile import get_manager
         self._split_jit = instrument_kernel(
-            jax.jit(self._split_packed), "split", name="serial/split_scan")
+            get_manager().jit_entry("serial/split_scan",
+                                    jax.jit(self._split_packed)),
+            "split", name="serial/split_scan")
         self._interaction_sets = _parse_interaction_constraints(
             config.interaction_constraints, dataset)
         self._forced_splits = _load_forced_splits(config.forcedsplits_filename)
@@ -204,17 +207,22 @@ class SerialTreeGrower:
                                      capacity, Bg, method=method)
             total = ghist[0].sum(axis=0)  # every row in exactly one code
             return per_feature_hist(ghist, efb_hist, total[0], total[1])
-        return instrument_kernel(fn, "hist", name="serial/leaf_histogram")
+        from ..compile import get_manager
+        return instrument_kernel(
+            get_manager().jit_entry(f"serial/leaf_histogram_c{capacity}", fn),
+            "hist", name="serial/leaf_histogram")
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn(self, capacity: int):
         efb = self._efb_dev
+        from ..compile import get_manager
+        pl = get_manager().jit_entry("serial/partition_leaf", partition_leaf)
 
         def fn(bins, perm, start, count, feature, threshold, default_left,
                miss_bin, is_cat, cat_bitset):
-            return partition_leaf(bins, perm, start, count, feature,
-                                  threshold, default_left, miss_bin, is_cat,
-                                  cat_bitset, capacity, efb=efb)
+            return pl(bins, perm, start, count, feature,
+                      threshold, default_left, miss_bin, is_cat,
+                      cat_bitset, capacity, efb=efb)
         return instrument_kernel(fn, "partition", name="serial/partition_leaf")
 
     # ------------------------------------------------------------------

@@ -1,0 +1,42 @@
+"""JAX version compatibility shims.
+
+The code targets the current jax API surface; this module backfills the
+pieces that moved between releases so the same source runs on the
+container's pinned jax as well:
+
+- `shard_map`: promoted out of `jax.experimental` (and its `check_rep`
+  kwarg renamed to `check_vma`) in newer releases. Callers always use
+  the new name/kwarg; the shim translates when only the experimental
+  API exists.
+- `pallas_hbm_space()`: `pltpu.HBM` replaced the older
+  `TPUMemorySpace.ANY` spelling for unblocked HBM operands in manual-DMA
+  kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+try:                                    # jax >= 0.6: public API, check_vma
+    from jax import shard_map as _new_shard_map
+
+    def shard_map(f=None, **kw):
+        if f is None:
+            return functools.partial(shard_map, **kw)
+        return _new_shard_map(f, **kw)
+
+except ImportError:                     # jax 0.4.x: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f=None, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:
+            return functools.partial(shard_map, **kw)
+        return _old_shard_map(f, **kw)
+
+
+def pallas_hbm_space(pltpu):
+    """Unblocked-HBM memory space constant for `pl.BlockSpec`, for
+    whichever spelling this jax provides."""
+    hbm = getattr(pltpu, "HBM", None)
+    return hbm if hbm is not None else pltpu.ANY

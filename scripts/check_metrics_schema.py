@@ -4,10 +4,15 @@
 Two artifact kinds (docs/OBSERVABILITY.md):
 
 - per-iteration metrics JSONL written by `metrics_file=` /
-  `--metrics-out` (one record per line, `obs.sink.validate_record`),
+  `--metrics-out` (one record per line, `obs.sink.validate_record`;
+  schema v1.1 records additionally carry `schema_minor` plus the AOT
+  compile-manager `compile.*`/`eval.*` counters and
+  compile/aot_load/aot_serialize phase timers),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
-  "parsed" key (`obs.sink.validate_bench_record` unwraps it).
+  "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py
+  may also write a BENCH_BIN63 sidecar (max_bin=63 config) — same
+  schema, validated the same way.
 
 Usage:
     python scripts/check_metrics_schema.py [FILE ...]

@@ -1,0 +1,321 @@
+"""AOT compile manager: cache keys, executable store, shape bucketing,
+warmup, and the zero-recompile acceptance check (docs/COMPILE_CACHE.md).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.compile import (CorruptBlobError, ExecutableStore,
+                                  bucket_rows, cache_key, config_signature,
+                                  get_manager, reset_manager,
+                                  shape_signature, signature_digest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def aot_env(tmp_path, monkeypatch):
+    """Fresh process-global manager writing to an isolated store."""
+    monkeypatch.setenv("LGBM_TPU_AOT_CACHE", str(tmp_path / "aot"))
+    monkeypatch.setenv("LGBM_TPU_WARMUP", "0")
+    reset_manager()
+    yield tmp_path / "aot"
+    reset_manager()
+
+
+def _aot_ready():
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+# -- shape bucketing ----------------------------------------------------
+
+def test_bucket_rows_ladder(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUCKET_MIN", "1024")
+    # below the threshold: exact shape (small jobs compile fast anyway)
+    assert bucket_rows(1000) == 1000
+    assert bucket_rows(1024) == 1024
+    # quarter-power-of-two ladder above it
+    assert bucket_rows(1025) == 1280
+    assert bucket_rows(1500) == 1536
+    assert bucket_rows(1536) == 1536
+    assert bucket_rows(5000) == 5120
+    assert bucket_rows(5100) == 5120
+    for n in (1025, 3000, 10**6, 10**7 + 3):
+        b = bucket_rows(n)
+        assert b >= n
+        assert b <= n * 1.25 + 1  # padding waste bounded by 25%
+
+
+def test_bucket_rows_disabled(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUCKET_MIN", "16")
+    monkeypatch.setenv("LGBM_TPU_SHAPE_BUCKETS", "0")
+    assert bucket_rows(12345) == 12345
+
+
+# -- cache keys ---------------------------------------------------------
+
+def test_signature_stable_across_equal_configs():
+    p = {"objective": "binary", "num_leaves": 31, "max_bin": 255}
+    s1 = config_signature(Config.from_params(dict(p)))
+    s2 = config_signature(Config.from_params(dict(p)))
+    assert signature_digest("e", s1) == signature_digest("e", s2)
+
+
+def test_signature_changes_with_trace_relevant_params():
+    base = {"objective": "binary", "num_leaves": 31}
+    d0 = signature_digest("e", config_signature(Config.from_params(base)))
+    for delta in ({"max_bin": 63}, {"num_leaves": 63},
+                  {"lambda_l2": 1.5}, {"objective": "regression"}):
+        d = signature_digest("e", config_signature(
+            Config.from_params({**base, **delta})))
+        assert d != d0, f"{delta} must change the compile signature"
+
+
+def test_signature_ignores_io_and_obs_params(tmp_path):
+    base = {"objective": "binary", "num_leaves": 31}
+    d0 = signature_digest("e", config_signature(Config.from_params(base)))
+    d1 = signature_digest("e", config_signature(Config.from_params(
+        {**base, "metrics_file": str(tmp_path / "m.jsonl"),
+         "output_model": str(tmp_path / "m.txt"), "verbosity": -1})))
+    assert d1 == d0
+
+
+def test_cache_key_tracks_shapes_and_statics():
+    a = jax.ShapeDtypeStruct((128, 4), jnp.float32)
+    b = jax.ShapeDtypeStruct((256, 4), jnp.float32)
+    k_a = cache_key("d", shape_signature((a,), {}))
+    assert k_a == cache_key("d", shape_signature((a,), {}))
+    assert k_a != cache_key("d", shape_signature((b,), {}))
+    assert k_a != cache_key("d", shape_signature(
+        (jax.ShapeDtypeStruct((128, 4), jnp.bfloat16),), {}))
+    assert k_a != cache_key("d2", shape_signature((a,), {}))
+    assert k_a != cache_key("d", shape_signature((a,), {"flag": True}))
+
+
+# -- executable store ---------------------------------------------------
+
+@pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
+def test_store_serialize_deserialize_execute(aot_env):
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load, serialize)
+    exe = jax.jit(lambda x: 2.0 * x + 1.0).lower(
+        jax.ShapeDtypeStruct((8,), jnp.float32)).compile()
+    store = ExecutableStore(str(aot_env))
+    assert store.save("k1", serialize(exe))
+    assert store.keys() == ["k1"]
+    loaded = deserialize_and_load(*store.load("k1"))
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(loaded(x)),
+                               2.0 * np.arange(8) + 1.0)
+
+
+def test_store_corrupt_blob_deleted(aot_env):
+    store = ExecutableStore(str(aot_env))
+    os.makedirs(store.env_dir(), exist_ok=True)
+    with open(store.path("bad"), "wb") as fh:
+        fh.write(b"this is not a pickled executable")
+    with pytest.raises(CorruptBlobError):
+        store.load("bad")
+    assert not os.path.exists(store.path("bad"))
+    assert store.load("bad") is None  # gone, not an error, on retry
+
+
+@pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
+def test_manager_corrupt_blob_falls_back_to_compile(aot_env):
+    mgr = get_manager()
+    if not mgr.aot_enabled:
+        pytest.skip("AOT disabled in this environment")
+    entry = mgr.shared_entry("test/affine", {"v": 1},
+                             lambda: jax.jit(lambda x: x + 3.0))
+    x = jnp.ones((16,), jnp.float32)
+    key = entry.key_for((x,), {})
+    os.makedirs(mgr.store.env_dir(), exist_ok=True)
+    with open(mgr.store.path(key), "wb") as fh:
+        fh.write(b"garbage" * 100)
+    out = entry(x)
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+    stats = mgr.snapshot()
+    assert stats.get("store_load_errors", 0) >= 1
+    assert stats.get("cache_misses", 0) >= 1
+    # the corrupt file was replaced by the fresh compile's blob
+    assert entry(x) is not None
+    assert mgr.snapshot().get("cache_hits", 0) >= 1
+
+
+@pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
+def test_shared_entry_warmup_spec_precompiles(aot_env):
+    from lightgbm_tpu.compile import warmup_entries
+    mgr = get_manager()
+    if not mgr.aot_enabled:
+        pytest.skip("AOT disabled in this environment")
+    entry = mgr.shared_entry("test/mul", {"v": 2},
+                             lambda: jax.jit(lambda x: x * 5.0))
+    entry.add_spec((jax.ShapeDtypeStruct((32,), jnp.float32),))
+    summary = warmup_entries()
+    assert summary["entries"] >= 1 and summary["compiled"] >= 1
+    before = mgr.snapshot().get("cache_misses", 0)
+    out = entry(jnp.ones((32,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+    assert mgr.snapshot().get("cache_misses", 0) == before  # warm hit
+
+
+# -- the acceptance check: zero recompiles on a same-bucket re-train ----
+
+def _make_binary(n, seed):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_second_same_bucket_train_compiles_nothing(aot_env, monkeypatch):
+    """ISSUE acceptance: training a second same-process dataset whose
+    row count lands in the same bucket performs ZERO XLA compilations —
+    both the AOT miss counter and the plain-jit recompile counter stay
+    flat while the hit counter moves."""
+    monkeypatch.setenv("LGBM_TPU_BUCKET_MIN", "4096")
+    reset_manager()
+    reg = obs.MetricsRegistry()
+    obs.activate(reg)
+    try:
+        params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+        X1, y1 = _make_binary(5000, 0)
+        b1 = lgb.train(params, lgb.Dataset(X1, label=y1), num_boost_round=4)
+        s0 = get_manager().snapshot()
+        c0 = dict(reg.counters)
+
+        X2, y2 = _make_binary(5100, 7)  # 5000 and 5100 both bucket to 5120
+        b2 = lgb.train(params, lgb.Dataset(X2, label=y2), num_boost_round=4)
+        s1 = get_manager().snapshot()
+        c1 = dict(reg.counters)
+    finally:
+        obs.deactivate(reg)
+
+    for ctr in ("cache_misses", "jit_compiles", "fallbacks"):
+        assert s1.get(ctr, 0) == s0.get(ctr, 0), \
+            f"second train incremented {ctr}: {s0} -> {s1}"
+        key = f"compile.{ctr}"
+        assert c1.get(key, 0) == c0.get(key, 0)
+    assert s1.get("cache_hits", 0) > s0.get("cache_hits", 0)
+    # both models actually learned on their own data
+    acc1 = np.mean((b1.predict(X1) > 0.5) == (y1 > 0))
+    acc2 = np.mean((b2.predict(X2) > 0.5) == (y2 > 0))
+    assert acc1 > 0.9 and acc2 > 0.9
+
+
+def test_bucket_padding_does_not_change_predictions(aot_env, monkeypatch):
+    """Same data trained with and without row bucketing produces the
+    same model (pad lanes are masked by the traced row count)."""
+    X, y = _make_binary(5000, 3)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+
+    monkeypatch.setenv("LGBM_TPU_SHAPE_BUCKETS", "0")
+    reset_manager()
+    p_exact = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=4).predict(X)
+
+    monkeypatch.setenv("LGBM_TPU_SHAPE_BUCKETS", "1")
+    monkeypatch.setenv("LGBM_TPU_BUCKET_MIN", "4096")
+    reset_manager()
+    p_bucket = lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=4).predict(X)
+    np.testing.assert_allclose(p_exact, p_bucket, rtol=1e-5, atol=1e-6)
+
+
+# -- device-side eval (satellite: early-stopping transfer guard) --------
+
+def test_device_eval_transfers_scalars_only(aot_env):
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(800, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X[:600], label=y[:600])
+    vs = lgb.Dataset(X[600:], label=y[600:], reference=ds)
+    params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
+              "num_leaves": 7, "verbose": -1}
+
+    reg = obs.MetricsRegistry()
+    obs.activate(reg)
+    try:
+        res_dev = {}
+        lgb.train(dict(params), ds, num_boost_round=4, valid_sets=[vs],
+                  valid_names=["v"], evals_result=res_dev,
+                  verbose_eval=False, early_stopping_rounds=3)
+        counters = dict(reg.counters)
+    finally:
+        obs.deactivate(reg)
+    # the transfer guard: no [N]-sized score pull per iteration, only
+    # 0-d metric scalars ride host<-device
+    assert counters.get("eval.host_transfer_rows", 0) == 0, counters
+    assert counters.get("eval.device_scalars", 0) > 0
+
+    os.environ["LGBM_TPU_DEVICE_EVAL"] = "0"
+    try:
+        res_host = {}
+        lgb.train(dict(params), ds, num_boost_round=4, valid_sets=[vs],
+                  valid_names=["v"], evals_result=res_host,
+                  verbose_eval=False, early_stopping_rounds=3)
+    finally:
+        del os.environ["LGBM_TPU_DEVICE_EVAL"]
+    for m in ("auc", "binary_logloss"):
+        np.testing.assert_allclose(res_dev["v"][m], res_host["v"][m],
+                                   rtol=1e-5, atol=1e-6)
+
+
+# -- warmup CLI (satellite: tier-1 smoke) -------------------------------
+
+def test_warmup_cli_smoke(tmp_path):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    data = tmp_path / "train.tsv"
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.6f")
+    conf = tmp_path / "warm.conf"
+    conf.write_text(f"data = {data}\n"
+                    "objective = binary\n"
+                    "num_leaves = 7\n")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               LGBM_TPU_AOT_CACHE=str(tmp_path / "aot"),
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "warmup",
+         "--conf", str(conf)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout + proc.stderr
+    assert "Warmup compiled" in out or "warmup is disabled" in out
+    if "Warmup compiled" in out:
+        store = ExecutableStore(str(tmp_path / "aot"))
+        # at least one executable persisted for the next process
+        blobs = []
+        for sub in (os.listdir(store.root)
+                    if os.path.isdir(store.root) else []):
+            d = os.path.join(store.root, sub)
+            blobs += [f for f in os.listdir(d) if f.endswith(".aotx")]
+        assert blobs, "warmup persisted no executables"
+
+
+def test_bench_sidecar_record_schema():
+    """The BENCH_BIN63 sidecar record bench.py writes conforms to
+    validate_bench_record (scripts/check_metrics_schema.py covers the
+    file once a bench run produces it)."""
+    rec = {"metric": "higgs_train_wallclock_bin63", "value": 100.0,
+           "unit": "seconds", "vs_baseline": 1.06,
+           "vs_baseline_with_compile": 0.9, "compile_s": 12.0,
+           "rows": 1048576, "iters": 20, "note": "extrapolated"}
+    assert obs.validate_bench_record(rec) == []
+    assert obs.validate_bench_record(json.loads(json.dumps(rec))) == []

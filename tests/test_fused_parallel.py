@@ -40,6 +40,7 @@ def _make(n=6000, f=8, seed=0, sort_labels=False):
     ("binary", True),          # a shard holds only one class
     ("regression", False),
 ])
+@pytest.mark.slow
 def test_fused_dp_matches_serial(objective, sort_labels):
     X, y = _make(sort_labels=sort_labels)
     base = {"objective": objective, "num_leaves": 31, "verbose": -1,
@@ -88,6 +89,7 @@ def test_fused_dp_uneven_shards():
     assert float(np.mean(np.abs(p1 - p2))) < 0.01
 
 
+@pytest.mark.slow
 def test_fused_dp_bagging_matches_serial():
     """Round-4: the sharded fused grower covers bagging via per-shard
     local permutations (reference SetBaggingData semantics per machine,
@@ -107,6 +109,7 @@ def test_fused_dp_bagging_matches_serial():
     assert float(np.mean(np.abs(p1 - p2))) < 1e-4
 
 
+@pytest.mark.slow
 def test_fused_dp_multiclass_matches_serial():
     """Multiclass (num_class trees/iter) through the sharded per-tree
     fused path."""
@@ -147,6 +150,7 @@ def _make_bundled(n=4000, seed=2):
     return X, y
 
 
+@pytest.mark.slow
 def test_parallel_learners_keep_efb_bundles():
     """Round-4: parallel learners consume EFB bundles directly (no more
     debundling — the reference's flagship distributed result depends on

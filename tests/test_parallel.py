@@ -49,12 +49,14 @@ def _train_with_learner(learner_name, X, y, rounds=15):
     return lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False)
 
 
+@pytest.mark.slow
 def test_data_parallel_quality(eight_devices):
     X, y = make_binary()
     bst = _train_with_learner("data", X, y)
     assert auc_score(y, bst.predict(X)) > 0.97
 
 
+@pytest.mark.slow
 def test_data_parallel_close_to_serial(eight_devices):
     """The HOST-LOOP data-parallel learner vs the HOST-LOOP serial
     grower. Bagging keeps data-parallel on the host-loop learner; the
@@ -86,18 +88,21 @@ def test_data_parallel_close_to_serial(eight_devices):
     # identical trees
     assert np.corrcoef(ps, pd)[0, 1] > 0.999
 
+@pytest.mark.slow
 def test_voting_parallel_quality(eight_devices):
     X, y = make_binary()
     bst = _train_with_learner("voting", X, y)
     assert auc_score(y, bst.predict(X)) > 0.96
 
 
+@pytest.mark.slow
 def test_feature_parallel_quality(eight_devices):
     X, y = make_binary()
     bst = _train_with_learner("feature", X, y)
     assert auc_score(y, bst.predict(X)) > 0.97
 
 
+@pytest.mark.slow
 def test_data_parallel_with_bagging(eight_devices):
     X, y = make_binary()
     params = {"objective": "binary", "verbose": -1, "tree_learner": "data",
@@ -113,6 +118,7 @@ def test_mesh_build(eight_devices):
     assert mesh.shape["data"] == 8
 
 
+@pytest.mark.slow
 def test_voting_wide_features_quality(eight_devices):
     """Voting path with F >> 2k (the regime PV-Tree exists for)."""
     rng = np.random.RandomState(5)
