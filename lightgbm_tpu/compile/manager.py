@@ -40,6 +40,12 @@ from .store import CorruptBlobError, ExecutableStore, store_enabled
 
 _FALLBACK = object()  # dispatch marker: this key uses plain jit forever
 
+
+def is_executable(exe: Any) -> bool:
+    """True only for a real compiled executable — not None and not the
+    plain-jit fallback marker (which means the compile FAILED)."""
+    return exe is not None and exe is not _FALLBACK
+
 _MAX_SHARED_ENTRIES = 32   # LRU cap: entries close over growers/datasets
 _MAX_EXECUTABLES = 128
 
@@ -106,7 +112,7 @@ class SharedEntry:
         except Exception as exc:
             log.debug("AOT executable %s rejected args (%s); falling back "
                       "to jit", self.name, exc)
-            mgr.executables[key] = _FALLBACK
+            mgr._remember(key, _FALLBACK)
             mgr.count("exec_fallbacks")
             return self.jit_fn()(*args, **statics)
 

@@ -8,8 +8,12 @@ executable can serve many datasets. The pieces:
   in the same bucket share every row-shaped executable; the pad rows are
   masked out by a traced row-count argument inside the kernels.
 - `environment_key()`: (jax version, backend, device kind/count,
-  process count) — anything that invalidates a serialized XLA executable
-  wholesale. The store namespaces its directory by this digest.
+  process count, x64 mode, package code fingerprint) — anything that
+  invalidates a serialized XLA executable wholesale. The code
+  fingerprint digests the package's own .py sources, so editing any
+  traced program (a kernel, a learner, an objective) moves the store to
+  a fresh directory instead of silently replaying a stale executable —
+  the same reason jax's compilation cache folds in its own version.
 - `signature_digest(name, sig)`: entry-point identity. Two jit entries
   with equal digests trace byte-identical programs and may share one
   compiled executable (all dataset-varying arrays are traced arguments).
@@ -116,7 +120,41 @@ def config_signature(config: Any) -> Dict[str, Any]:
     return out
 
 
+_CODE_FINGERPRINT: str = ""
+
+
+def code_fingerprint() -> str:
+    """Digest of the package's own .py sources (paths + contents).
+
+    Serialized executables bake in the traced program, so any code
+    change — not just config changes — must invalidate them. Hashing
+    the sources rather than a version string means dev checkouts and
+    patched installs invalidate correctly without a version bump."""
+    global _CODE_FINGERPRINT
+    if not _CODE_FINGERPRINT:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = []
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            files += [os.path.join(dirpath, f) for f in filenames
+                      if f.endswith(".py")]
+        h = hashlib.sha256()
+        for path in sorted(files):
+            h.update(os.path.relpath(path, pkg).encode())
+            try:
+                with open(path, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                h.update(b"<unreadable>")
+        _CODE_FINGERPRINT = h.hexdigest()[:20]
+    return _CODE_FINGERPRINT
+
+
 def environment_key() -> str:
+    try:
+        from .. import __version__ as pkg_version
+    except Exception:
+        pkg_version = "unknown"
     devs = jax.devices()
     env = {
         "jax": jax.__version__,
@@ -124,6 +162,10 @@ def environment_key() -> str:
         "device_kind": devs[0].device_kind if devs else "none",
         "device_count": len(devs),
         "process_count": jax.process_count(),
+        # x64 changes every traced dtype, hence every executable
+        "x64": bool(jax.config.jax_enable_x64),
+        "package": pkg_version,
+        "code": code_fingerprint(),
     }
     return _digest(env)
 

@@ -16,6 +16,16 @@ LGBM_TPU_AOT=0 disables the store (and all AOT dispatch) entirely.
 
 Corrupt or undeserializable blobs are deleted and reported through the
 manager's counters; callers fall back to plain jit.
+
+TRUST BOUNDARY: the cache directory must only be writable by the user
+running training. Payloads are pickled (the serialized triple's
+in/out pytrees have no stable non-pickle encoding, and jax's own
+deserialize_and_load unpickles the blob regardless), so a tampered
+.aotx file executes arbitrary code at load time — exactly like jax's
+persistent compilation cache. The store therefore creates its
+directories 0700 and blob files 0600. Do not point $LGBM_TPU_AOT_CACHE
+at a world- or group-writable path; the default is per-user, and its
+contents deserve the same trust as ~/.cache/jax.
 """
 from __future__ import annotations
 
@@ -85,11 +95,26 @@ class ExecutableStore:
             self.invalidate(key)
             raise CorruptBlobError(str(exc)) from exc
 
+    def _ensure_dirs(self) -> None:
+        """Create root + env dir owner-only (0700): blobs are pickled,
+        so the directory is a code-execution surface for anyone who can
+        write to it (module docstring, TRUST BOUNDARY)."""
+        if os.path.isdir(self.env_dir()):
+            return
+        created = [d for d in (self.root, self.env_dir())
+                   if not os.path.isdir(d)]
+        os.makedirs(self.env_dir(), mode=0o700, exist_ok=True)
+        for d in created:
+            try:
+                os.chmod(d, 0o700)  # makedirs mode is masked by umask
+            except OSError:
+                pass
+
     def save(self, key: str, triple: Tuple[bytes, Any, Any]) -> bool:
         """Atomically persist a serialized triple (tmp file + rename, so
         a concurrent reader never sees a torn write)."""
         try:
-            os.makedirs(self.env_dir(), exist_ok=True)
+            self._ensure_dirs()
             payload = {"v": _PAYLOAD_VERSION, "jax": jax.__version__,
                        "key": key, "blob": triple[0],
                        "in_tree": triple[1], "out_tree": triple[2]}

@@ -29,7 +29,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils import log
 from . import signature as S
-from .manager import CompileManager, SharedEntry, get_manager
+from .manager import (CompileManager, SharedEntry, get_manager,
+                      is_executable)
 
 # Background threads must never be mid-XLA-call while the interpreter
 # tears down its C++ state (PJRT client destruction aborts the process
@@ -90,7 +91,9 @@ def warmup_entries(jobs: Optional[int] = None) -> Dict[str, Any]:
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
             for exe in pool.map(_one, pending):
-                compiled += exe is not None
+                # a _FALLBACK result means the compile FAILED — only
+                # real executables count toward the warmup summary
+                compiled += is_executable(exe)
     return {"entries": len(pending), "compiled": compiled,
             "seconds": time.perf_counter() - t0,
             "stats": mgr.snapshot()}

@@ -106,6 +106,18 @@ def test_cache_key_tracks_shapes_and_statics():
     assert k_a != cache_key("d", shape_signature((a,), {"flag": True}))
 
 
+def test_environment_key_tracks_code_identity(monkeypatch):
+    """REVIEW fix: the environment key must change when the package's
+    own code changes, or a store from an older checkout would silently
+    replay stale executables after a kernel bugfix."""
+    from lightgbm_tpu.compile import signature as S
+    assert S.code_fingerprint()  # non-empty, cached
+    k0 = S.environment_key()
+    assert k0 == S.environment_key()  # deterministic
+    monkeypatch.setattr(S, "_CODE_FINGERPRINT", "0" * 20)
+    assert S.environment_key() != k0
+
+
 # -- executable store ---------------------------------------------------
 
 @pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
@@ -121,6 +133,19 @@ def test_store_serialize_deserialize_execute(aot_env):
     x = jnp.arange(8, dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(loaded(x)),
                                2.0 * np.arange(8) + 1.0)
+
+
+@pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
+def test_store_dirs_created_owner_only(aot_env):
+    """Blobs are pickled, so the store directory is a code-execution
+    surface: it must be created 0700 (module docstring TRUST BOUNDARY)."""
+    store = ExecutableStore(str(aot_env))
+    exe = jax.jit(lambda x: x + 1.0).lower(
+        jax.ShapeDtypeStruct((4,), jnp.float32)).compile()
+    from jax.experimental.serialize_executable import serialize
+    assert store.save("kperm", serialize(exe))
+    for d in (store.root, store.env_dir()):
+        assert os.stat(d).st_mode & 0o777 == 0o700, d
 
 
 def test_store_corrupt_blob_deleted(aot_env):
@@ -171,6 +196,26 @@ def test_shared_entry_warmup_spec_precompiles(aot_env):
     out = entry(jnp.ones((32,), jnp.float32))
     np.testing.assert_allclose(np.asarray(out), 5.0)
     assert mgr.snapshot().get("cache_misses", 0) == before  # warm hit
+
+
+@pytest.mark.skipif(not _aot_ready(), reason="serialize_executable absent")
+def test_warmup_counts_only_real_compiles(aot_env):
+    """REVIEW fix: a compile failure produces the plain-jit fallback
+    marker, which the warmup summary must NOT report as 'compiled'."""
+    from lightgbm_tpu.compile import warmup_entries
+    mgr = get_manager()
+    if not mgr.aot_enabled:
+        pytest.skip("AOT disabled in this environment")
+
+    def boom(x):
+        raise ValueError("intentional trace failure")
+
+    entry = mgr.shared_entry("test/boom", {"v": 3}, lambda: jax.jit(boom))
+    entry.add_spec((jax.ShapeDtypeStruct((8,), jnp.float32),))
+    summary = warmup_entries()
+    assert summary["entries"] == 1
+    assert summary["compiled"] == 0
+    assert mgr.snapshot().get("fallbacks", 0) >= 1
 
 
 # -- the acceptance check: zero recompiles on a same-bucket re-train ----
@@ -237,6 +282,25 @@ def test_bucket_padding_does_not_change_predictions(aot_env, monkeypatch):
 
 
 # -- device-side eval (satellite: early-stopping transfer guard) --------
+
+def test_device_sum_matches_float64():
+    """REVIEW fix: device metric reductions accumulate with f64-grade
+    accuracy (compensated sum on f32 backends), so device eval cannot
+    drift from the host float64 path enough to flip early stopping."""
+    from lightgbm_tpu.metric.metrics import _sum_dev
+    rng = np.random.default_rng(17)
+    # non-multiple-of-lane length exercises the padding path; lognormal
+    # spread + large N is where a naive f32 running sum drifts
+    x = rng.lognormal(mean=0.0, sigma=2.0, size=200_003).astype(np.float32)
+    ref = float(np.sum(x.astype(np.float64)))
+    got = float(_sum_dev(jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=2e-6)
+    # cancellation-heavy input: alternating large +/- pairs plus a tail
+    y = np.repeat([1e6, -1e6], 5000).astype(np.float32)
+    y = np.concatenate([y, rng.normal(size=1001).astype(np.float32)])
+    ref = float(np.sum(y.astype(np.float64)))
+    got = float(_sum_dev(jnp.asarray(y)))
+    np.testing.assert_allclose(got, ref, atol=1e-2)
 
 def test_device_eval_transfers_scalars_only(aot_env):
     rng = np.random.default_rng(5)
