@@ -34,7 +34,19 @@ Env knobs: BENCH_ROWS (default 10_485_760), BENCH_ITERS (default 500),
 BENCH_BUDGET_S (default 420), BENCH_LEAVES/BENCH_BIN (default 255),
 BENCH_EXAMPLE=0 to skip the real-data example run, BENCH_BIN63=0 to
 skip the max_bin=63 sidecar (written to BENCH_BIN63.json next to this
-file when budget allows — same one-line schema, never on stdout).
+file when budget allows — same one-line schema, never on stdout),
+BENCH_QUANT=1 to train with quantized gradients
+(use_quantized_grad, docs/QUANTIZED_GRADIENTS.md) at
+BENCH_QUANT_BINS levels (default 64).
+
+The summary line additionally reports provenance + latency shape
+(appended after the pre-existing keys, which stay byte-identical):
+hist_method (resolved histogram kernel variant), quantized 0/1 (+
+num_grad_quant_bins when on), iter_p50_s / iter_p90_s over the
+individually synced sample iterations, and hist_share — the histogram
+phase's fraction of the accounted core tree phases when the obs
+registry saw per-phase spans (host-loop learners; the fused
+single-dispatch program exposes no host-visible phases).
 
 Cold-session compile: the AOT executable store (docs/COMPILE_CACHE.md)
 is preloaded by train() itself; a prior `python -m lightgbm_tpu warmup`
@@ -62,10 +74,12 @@ TEST_ROWS = 500_000
 REF_EXAMPLE = "/root/reference/examples/binary_classification"
 
 T0 = time.time()
+QUANT = os.environ.get("BENCH_QUANT", "0") != "0"
+QUANT_BINS = int(os.environ.get("BENCH_QUANT_BINS", 64))
 STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "iters_done": 0, "iter_times": [], "test_auc": None,
          "example_auc": None, "predict_us_per_row": None,
-         "example_auc_reference": None}
+         "example_auc_reference": None, "hist_method": None}
 # obs.MetricsRegistry activated in main() once lightgbm_tpu is imported;
 # emit() appends its per-phase breakdown AFTER the pre-existing keys so
 # the line stays byte-compatible on everything consumers already parse
@@ -138,6 +152,22 @@ def emit(partial: bool) -> None:
                                 == 0)
     except Exception:
         pass
+    # provenance + latency shape (schema minor 2) — appended after the
+    # pre-existing keys so existing consumers parse the same prefix
+    if STATE["hist_method"]:
+        out["hist_method"] = STATE["hist_method"]
+    out["quantized"] = int(QUANT)
+    if QUANT:
+        out["num_grad_quant_bins"] = QUANT_BINS
+    if it:
+        out["iter_p50_s"] = round(float(np.percentile(it, 50)), 4)
+        out["iter_p90_s"] = round(float(np.percentile(it, 90)), 4)
+    if REGISTRY is not None:
+        core = sum(REGISTRY.times.get(ph, 0.0)
+                   for ph in ("hist", "split", "partition"))
+        if core > 0:
+            out["hist_share"] = round(
+                REGISTRY.times.get("hist", 0.0) / core, 4)
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -299,6 +329,9 @@ def main():
     }
     if os.environ.get("BENCH_HIST_DTYPE"):
         params["tpu_hist_dtype"] = os.environ["BENCH_HIST_DTYPE"]
+    if QUANT:
+        params["use_quantized_grad"] = True
+        params["num_grad_quant_bins"] = QUANT_BINS
     ds = lgb.Dataset(X, label=y)
 
     # first iteration on the SAME booster/shapes pays the compile
@@ -308,6 +341,8 @@ def main():
     jax.block_until_ready(bst._gbdt.device_score_state())
     STATE["compile_s"] = time.time() - t0
     STATE["iters_done"] = 1
+    from lightgbm_tpu.ops import histogram as H
+    STATE["hist_method"] = H.hist_method(bst._gbdt.config) or "scatter"
 
     # steady state: run the remaining iterations as one async stream
     # (dispatches pipeline; block once at the end), sampling a few
@@ -319,7 +354,9 @@ def main():
         t0 = time.time()
         bst.update()
         jax.block_until_ready(bst._gbdt.device_score_state())
-        STATE["iter_times"].append(time.time() - t0)
+        dt = time.time() - t0
+        STATE["iter_times"].append(dt)
+        REGISTRY.observe("iter_s", dt)
         STATE["iters_done"] += 1
     # budget-adaptive iteration count: always leave room for the
     # quality checks (test AUC + the reference-example run), reporting

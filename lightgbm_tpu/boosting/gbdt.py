@@ -422,7 +422,8 @@ class GBDT:
         self.models.append(pending)
         self.iter += 1
         if self.iter % self._fused_check_every == 0:
-            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-1:]):
+            if all(v <= 1 for v in
+                   self._batched_tree_stats(self.models[-1:])[0]):
                 self._trim_degenerate_tail()
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
@@ -455,52 +456,77 @@ class GBDT:
         # would cost a tunnel round trip, so check periodically and
         # roll back ALL trailing degenerate iterations on detection
         if self.iter % self._fused_check_every == 0:
-            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-k:]):
+            if all(v <= 1 for v in
+                   self._batched_tree_stats(self.models[-k:])[0]):
                 self._trim_degenerate_tail()
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
                 return True
         return False
 
-    @staticmethod
-    def _tree_num_leaves(t) -> int:
+    def _tree_num_leaves(self, t) -> int:
         """Leaf count without forcing a full host materialization."""
+        return self._batched_tree_stats([t])[0][0]
+
+    def _batched_tree_stats(self, trees, with_gains: bool = False):
+        """(leaf_counts, split_gain_arrays) for ``trees`` with at most
+        ONE jax.device_get across all of them. The periodic stop check
+        and the telemetry sampler both read these per tree; a per-tree
+        fetch costs a device round trip each (~1.4 s/tree on a remote
+        tunnel — see _materialize_models), so every unmaterialized
+        tree's scalars ride one batched transfer and the leaf count is
+        cached on the PendingTree (immutable once grown)."""
         from ..treelearner.fused import PendingTree
-        if isinstance(t, PendingTree) and t._tree is None:
+        refs: Dict = {}
+        for i, t in enumerate(trees):
+            if not (isinstance(t, PendingTree) and t._tree is None):
+                continue
             if t._ta is None and t.batch is None and t.resolver is not None:
-                t.resolver()
-            if t._ta is None and t.batch is not None \
-                    and t.batch._host is None:
-                # fetch ONE scalar, not the whole K-tree stack
-                return int(jax.device_get(
-                    t.batch.stack["n_leaves"][t.index]))
-            return int(jax.device_get(t.tree_arrays["n_leaves"]))
-        return t.num_leaves
+                t.resolver()       # dispatch queued iterations first
+            stacked = t._ta is None and t.batch is not None \
+                and t.batch._host is None
+            src = t.batch.stack if stacked else t.tree_arrays
+            if t._n_leaves_host is None:
+                refs[(i, "n_leaves")] = (
+                    src["n_leaves"][t.index] if stacked
+                    else src["n_leaves"])
+            if with_gains:
+                refs[(i, "split_gain")] = (
+                    src["split_gain"][t.index] if stacked
+                    else src["split_gain"])
+        fetched = jax.device_get(refs) if refs else {}
+        counts, gains = [], []
+        for i, t in enumerate(trees):
+            if isinstance(t, PendingTree) and t._tree is None:
+                if (i, "n_leaves") in fetched:
+                    t._n_leaves_host = int(fetched[(i, "n_leaves")])
+                counts.append(int(t._n_leaves_host))
+                if with_gains:
+                    g = np.asarray(fetched[(i, "split_gain")])
+                    gains.append(g[:max(counts[-1] - 1, 0)])
+            else:
+                tree = t._tree if isinstance(t, PendingTree) else t
+                counts.append(int(tree.num_leaves))
+                if with_gains:
+                    gains.append(np.asarray(
+                        tree.split_gain[:max(tree.num_leaves - 1, 0)]))
+        return counts, gains
 
     def telemetry_stats(self) -> Dict[str, float]:
         """Per-iteration model/memory stats for the obs layer (only
         called when telemetry is enabled — the PendingTree fetches here
         cost a device round trip the normal path never pays)."""
-        from ..treelearner.fused import PendingTree
         k = self.num_tree_per_iteration
         stats: Dict[str, float] = {}
-        leaves = 0
         best_gain = 0.0
-        for t in self.models[-k:]:
-            leaves += self._tree_num_leaves(t)
-            try:
-                if isinstance(t, PendingTree) and t._tree is None:
-                    gains = np.asarray(
-                        jax.device_get(t.tree_arrays["split_gain"]))
-                else:
-                    tree = t._tree if isinstance(t, PendingTree) else t
-                    gains = np.asarray(tree.split_gain[:max(
-                        tree.num_leaves - 1, 0)])
-                if gains.size:
-                    best_gain = max(best_gain, float(np.max(gains)))
-            except Exception:
-                pass
-        stats["num_leaves"] = int(leaves)
+        # one batched device fetch serves leaf counts AND gains of all
+        # k class-trees of the iteration
+        counts, gain_arrays = self._batched_tree_stats(
+            self.models[-k:], with_gains=True)
+        for gains in gain_arrays:
+            if gains.size:
+                best_gain = max(best_gain, float(np.max(gains)))
+        stats["num_leaves"] = int(sum(counts))
         stats["best_gain"] = round(best_gain, 6)
         gauges = {}
         bins = getattr(self.train_data, "bins", None)
@@ -546,7 +572,8 @@ class GBDT:
         k = self.num_tree_per_iteration
         removed = 0
         while len(self.models) > k:
-            if all(self._tree_num_leaves(t) <= 1 for t in self.models[-k:]):
+            if all(v <= 1 for v in
+                   self._batched_tree_stats(self.models[-k:])[0]):
                 del self.models[-k:]
                 self.iter -= 1
                 removed += 1

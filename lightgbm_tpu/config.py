@@ -491,6 +491,25 @@ class Config:
     tpu_fused: bool = True
     num_gpu: int = 1
 
+    # --- quantized-gradient training (docs/QUANTIZED_GRADIENTS.md) ---
+    # Quantized Training of Gradient Boosting Decision Trees (Shi et
+    # al., NeurIPS 2022; reference use_quantized_grad). Gradients and
+    # hessians are stochastically rounded to small integers once per
+    # iteration and the histogram kernels accumulate in int32, halving
+    # the grad/hess HBM traffic and the parallel-learner collective
+    # payloads. Off by default: the f32 path is byte-identical.
+    use_quantized_grad: bool = False
+    # total signed grad levels / unsigned hess levels. 4..64: the
+    # ceiling keeps per-chunk integer partial sums exactly
+    # representable in the f32/bf16 MXU accumulation paths
+    # (131072-row chunks x qmax 63 < 2^24).
+    num_grad_quant_bins: int = 4
+    # refit leaf outputs from exact f32 grad/hess sums after the
+    # quantized growth (reference quant_train_renew_leaf)
+    quant_train_renew_leaf: bool = True
+    # stochastic vs nearest rounding of grad/hess to integer levels
+    stochastic_rounding: bool = True
+
     # --- io (train file mode) ---
     input_model: str = ""
     output_model: str = "LightGBM_model.txt"
@@ -584,6 +603,9 @@ class Config:
         if self.tpu_hist_dtype not in ("bfloat16", "float32"):
             log.fatal("tpu_hist_dtype must be 'bfloat16' or 'float32', "
                       "got %r", self.tpu_hist_dtype)
+        if not 4 <= self.num_grad_quant_bins <= 64:
+            log.fatal("num_grad_quant_bins must be in [4, 64], got %d",
+                      self.num_grad_quant_bins)
         self.objective = _resolve_objective_name(self.objective)
         self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
                          "goss": "goss", "rf": "rf",

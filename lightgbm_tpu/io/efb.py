@@ -247,11 +247,13 @@ def per_feature_hist(group_hist, hist_tables, sum_g, sum_h):
     import jax.numpy as jnp
     gather_idx, valid, mfb_oh, bg = hist_tables
     flat = group_hist.reshape(-1, 2)
-    fh = flat[gather_idx] * valid[..., None]          # [F, Bmax, 2]
+    # astype keeps quantized int32 histograms in exact integer space
+    # (no-op for the f32 path: valid/mfb_oh are stored f32)
+    fh = flat[gather_idx] * valid[..., None].astype(flat.dtype)
     total = jnp.stack([sum_g, sum_h]).astype(fh.dtype)  # [2]
     rest = fh.sum(axis=1)                              # [F, 2]
     fill = total[None, :] - rest                       # [F, 2]
-    return fh + mfb_oh[..., None] * fill[:, None, :]
+    return fh + mfb_oh[..., None].astype(fh.dtype) * fill[:, None, :]
 
 
 def bundle_eligible(m) -> bool:

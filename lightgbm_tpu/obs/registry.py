@@ -136,7 +136,7 @@ class MetricsRegistry:
                 out[f"phase_{ph}_s"] = round(self.times[ph], 3)
         for key in sorted(self.counters):
             if key.startswith(("collective.", "kernel.", "compile.",
-                               "eval.")):
+                               "eval.", "hist.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out

@@ -4,7 +4,7 @@ One JSON object per line, one line per (sampled) boosting iteration.
 The schema is additive-only within a version: consumers must tolerate
 unknown keys; removing or retyping a key bumps SCHEMA_VERSION.
 
-Iteration record (v1.1):
+Iteration record (v1.2):
 
   required: schema_version (int), iteration (int >= 0), t_iter_s,
             t_hist_s, t_split_s, t_partition_s, t_other_s (numbers,
@@ -15,7 +15,11 @@ Iteration record (v1.1):
             cache hit/miss/store counters and "eval.*" device-reduction
             counters under `counters`, the "compile"/"aot_load"/
             "aot_serialize" phase timers under `phases`, and "aot_*"
-            manager gauges under `gauges`),
+            manager gauges under `gauges`; minor 2 adds the
+            quantized-gradient pipeline fields: "hist.quant_*"
+            counters under `counters` — requantize passes, packed
+            collective bytes moved, per-leaf overflow escalations —
+            and the "hist.quant_bins" gauge under `gauges`),
             phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
             metrics (object: "<dataset>/<metric>" -> number),
@@ -31,8 +35,9 @@ from typing import Any, Dict, List, Optional
 
 SCHEMA_VERSION = 1
 # additive revision within SCHEMA_VERSION (see module docstring); bumped
-# to 1 when the compile-manager counters/timers joined the record
-SCHEMA_MINOR = 1
+# to 1 when the compile-manager counters/timers joined the record, to 2
+# when the quantized-gradient hist.quant_* counters/gauges joined
+SCHEMA_MINOR = 2
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -43,7 +48,12 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "predict_us_per_row", "example_auc",
                        "example_auc_reference_measured",
                        "warm_start", "aot_cache_hits", "aot_cache_misses",
-                       "aot_store_loads", "aot_compile_s")
+                       "aot_store_loads", "aot_compile_s",
+                       # quantized-gradient pipeline (schema minor 2)
+                       "quantized", "num_grad_quant_bins",
+                       "iter_p50_s", "iter_p90_s", "hist_share")
+# optional string-typed bench keys (minor 2): histogram kernel variant
+_BENCH_OPTIONAL_STR = ("hist_method",)
 
 
 def _num_map_problems(rec: Dict[str, Any], key: str,
@@ -131,6 +141,9 @@ def validate_bench_record(rec: Any) -> List[str]:
         if key in rec and (not isinstance(rec[key], (int, float))
                            or isinstance(rec[key], bool)):
             problems.append(f"{key!r} must be a number")
+    for key in _BENCH_OPTIONAL_STR:
+        if key in rec and not isinstance(rec[key], str):
+            problems.append(f"{key!r} must be a string")
     for key, v in (rec.items() if isinstance(rec, dict) else ()):
         if key.startswith("phase_") and (not isinstance(v, (int, float))
                                          or isinstance(v, bool)):

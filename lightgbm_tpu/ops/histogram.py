@@ -27,6 +27,16 @@ hist entries are pairs), counts are recovered as
 (feature_histogram.hpp cnt_factor).
 
 Output layout: ``[F, B, 2]`` float32, channel 0 = grad, 1 = hess.
+
+Quantized-gradient mode (ops/quantize.py, config use_quantized_grad):
+every kernel here also accepts INTEGER grad/hess — the stochastically
+rounded levels |qg| <= 31, qh <= 63 — and then accumulates exactly,
+returning ``[F, B, 2]`` int32. The MXU formulations keep their one-hot
+matmuls (small-integer inputs are exact even in bfloat16, and per-chunk
+partial sums stay under 2^24 so the f32 MXU accumulators are exact) and
+convert each chunk's partial to int32 before the running accumulation,
+so whole-dataset integer sums never round. Dispatch is by input dtype:
+``jnp.issubdtype(grad.dtype, jnp.integer)``.
 """
 from __future__ import annotations
 
@@ -47,13 +57,16 @@ def histogram_scatter(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """Scatter-add histogram: oracle + CPU path.
 
     bins: [C, F] integer bin codes; grad/hess: [C] float32 (zeros for
-    padding rows). Returns [F, B, 2] float32.
+    padding rows) or int32 quantized levels. Returns [F, B, 2] in f32,
+    or int32 for integer inputs (exact integer scatter-adds).
     """
     c, f = bins.shape
     b = bins.astype(jnp.int32)
-    hist = jnp.zeros((f, num_bins, 2), dtype=jnp.float32)
+    acc = (jnp.int32 if jnp.issubdtype(grad.dtype, jnp.integer)
+           else jnp.float32)
+    hist = jnp.zeros((f, num_bins, 2), dtype=acc)
     feat_idx = jnp.broadcast_to(jnp.arange(f, dtype=jnp.int32)[None, :], (c, f))
-    vals = jnp.stack([grad, hess], axis=-1).astype(jnp.float32)  # [C, 2]
+    vals = jnp.stack([grad, hess], axis=-1).astype(acc)          # [C, 2]
     vals = jnp.broadcast_to(vals[:, None, :], (c, f, 2))
     return hist.at[feat_idx.reshape(-1), b.reshape(-1)].add(
         vals.reshape(-1, 2), mode="drop")
@@ -74,11 +87,11 @@ def _hist_pallas_kernel(bins_ref, grad_ref, hess_ref, out_ref, *, num_bins: int)
         out_ref[...] = jnp.zeros_like(out_ref)
 
     bins = bins_ref[...]            # [Rb, F] int32
-    g = grad_ref[...]               # [Rb, 1] f32
-    h = hess_ref[...]               # [Rb, 1] f32
+    g = grad_ref[...]               # [Rb, 1] f32 (or i32 levels)
+    h = hess_ref[...]               # [Rb, 1] f32 (or i32 levels)
 
     def body(b, _):
-        mask = (bins == b).astype(jnp.float32)          # [Rb, F]
+        mask = (bins == b).astype(g.dtype)              # [Rb, F]
         gsum = jnp.sum(mask * g, axis=0)                # [F]
         hsum = jnp.sum(mask * h, axis=0)                # [F]
         idx = (slice(None), pl.dslice(b, 1), slice(None))
@@ -97,6 +110,8 @@ def histogram_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     from jax.experimental import pallas as pl
 
     c, f = bins.shape
+    acc = (jnp.int32 if jnp.issubdtype(grad.dtype, jnp.integer)
+           else jnp.float32)
     nblk = max(1, (c + rows_per_block - 1) // rows_per_block)
     pad = nblk * rows_per_block - c
     b32 = bins.astype(jnp.int32)
@@ -115,9 +130,9 @@ def histogram_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((2, num_bins, f), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, num_bins, f), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((2, num_bins, f), acc),
         interpret=interpret,
-    )(b32, grad.astype(jnp.float32)[:, None], hess.astype(jnp.float32)[:, None])
+    )(b32, grad.astype(acc)[:, None], hess.astype(acc)[:, None])
     return jnp.transpose(out, (2, 1, 0))  # → [F, B, 2]
 
 
@@ -159,8 +174,15 @@ def histogram_radix(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     learner's single-precision histograms (gpu_use_dp=false default).
     Rows are processed in ``row_chunk`` chunks via lax.scan so the
     materialized one-hots stay bounded.
+
+    Integer grad/hess (quantized levels): the per-chunk matmul still
+    runs in ``dtype`` with f32 accumulation — exact, since
+    row_chunk * qmax < 2^24 — and each chunk partial is converted to
+    int32 before entering the scan carry, so the whole-dataset sums are
+    exact int32.
     """
     r, f = bins.shape
+    int_out = jnp.issubdtype(grad.dtype, jnp.integer)
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
     Fc = max(1, 128 // Bl)          # N tile = Fc*Bl ≈ 128
@@ -193,8 +215,11 @@ def histogram_radix(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # TPU matmul default feeds bf16 into the MXU; for f32 inputs ask
         # for full f32 precision, for bf16 inputs default is already it
         prec = ("highest" if dtype == jnp.float32 else "default")
-        return jnp.einsum("rcm,rcn->cmn", a, mlo, precision=prec,
+        part = jnp.einsum("rcm,rcn->cmn", a, mlo, precision=prec,
                           preferred_element_type=jnp.float32)
+        # quantized levels: the f32 partial holds exact integers
+        # (row_chunk * qmax < 2^24) — snap to int32 for the carry
+        return part.astype(jnp.int32) if int_out else part
 
     nck = -(-r // row_chunk)
     if nck <= 1:
@@ -209,7 +234,8 @@ def histogram_radix(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             bc, gc, hc = ck
             return acc + chunk_hist(bc, gc, hc), None
 
-        init = jnp.zeros((C, 2 * Fc * Bh, Fc * Bl), jnp.float32)
+        init = jnp.zeros((C, 2 * Fc * Bh, Fc * Bl),
+                         jnp.int32 if int_out else jnp.float32)
         h_all, _ = jax.lax.scan(
             step, init,
             (bp.reshape(nck, row_chunk, Fp),
@@ -266,9 +292,14 @@ def _chunk_onehot_consts(Fc, Bh, Bl, dtype):
     return ex_lo, slot_lo, ex_hi, slot_hi
 
 
-def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype):
+def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype,
+                  int_out=False):
     """Accumulate CC feature chunks of ``ct`` [CC*Fc, Rb] into
-    ``out_ref`` [1, CC, 2*Fc*Bh, Fc*Bl] (one super-chunk's block)."""
+    ``out_ref`` [1, CC, 2*Fc*Bh, Fc*Bl] (one super-chunk's block).
+
+    ``int_out``: out_ref is int32 and g_t/h_t hold quantized levels —
+    the per-block matmul partial (exact in its f32 accumulator, bounded
+    by Rb * qmax < 2^24) is snapped to int32 before accumulating."""
     prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
     lo_t = (ct & (Bl - 1)).astype(dtype)
@@ -290,12 +321,15 @@ def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype):
         ph = jax.lax.dot_general(
             ah, mlo_t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
+        if int_out:
+            pg = pg.astype(jnp.int32)
+            ph = ph.astype(jnp.int32)
         out_ref[0, c, 0:fch, :] += pg
         out_ref[0, c, fch:2 * fch, :] += ph
 
 
 def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, CC, Fc,
-                         Bh, Bl, bl_bits, dtype):
+                         Bh, Bl, bl_bits, dtype, int_out=False):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(1) == 0)
@@ -306,7 +340,7 @@ def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, CC, Fc,
     g_t = gh_t_ref[0:1, :].astype(dtype)          # [1, Rb]
     h_t = gh_t_ref[1:2, :].astype(dtype)
     _accum_chunks(ct, g_t, h_t, out_ref, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
-                  bl_bits=bl_bits, dtype=dtype)
+                  bl_bits=bl_bits, dtype=dtype, int_out=int_out)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "dtype",
@@ -324,6 +358,7 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     from jax.experimental import pallas as pl
 
     r, f = bins.shape
+    int_out = jnp.issubdtype(grad.dtype, jnp.integer)
     bh_bits, bl_bits = _radix_dims(num_bins)
     Bh, Bl = 1 << bh_bits, 1 << bl_bits
     Fc = max(1, 128 // Bl)
@@ -341,6 +376,7 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         b = jnp.pad(b, ((0, 0), (0, Fp - f)), constant_values=0)
     nblk = max(1, -(-r // rows_per_block))
     pad_r = nblk * rows_per_block - r
+    # quantized levels ride the f32 lanes exactly (|level| < 2^16)
     gh_t = jnp.stack([grad.astype(jnp.float32),
                       hess.astype(jnp.float32)], axis=0)       # [2, r]
     if pad_r:
@@ -349,7 +385,7 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     out = pl.pallas_call(
         functools.partial(_radix_pallas_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
-                          bl_bits=bl_bits, dtype=dtype),
+                          bl_bits=bl_bits, dtype=dtype, int_out=int_out),
         grid=(CS, nblk),
         in_specs=[
             pl.BlockSpec((SPf, rows_per_block), lambda s, i: (s, i)),
@@ -358,7 +394,8 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         out_specs=pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
                                lambda s, i: (s, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
-                                       jnp.float32),
+                                       jnp.int32 if int_out
+                                       else jnp.float32),
         interpret=interpret,
     )(b.T, gh_t)
 
@@ -407,7 +444,8 @@ def planar_grid_dims(num_bins: int, code_bits: int, num_cols: int):
 
 
 def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
-                         Bl, bl_bits, dtype, code_bits, gh_off, Rb, SP):
+                         Bl, bl_bits, dtype, code_bits, gh_off, Rb, SP,
+                         quant=False):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(1)
@@ -426,10 +464,17 @@ def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
         pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
         valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
 
-        gh = jax.lax.bitcast_convert_type(
-            gh_ref[gh_off:gh_off + 2, :], jnp.float32)
-        g_t = (gh[0:1, :] * valid).astype(dtype)
-        h_t = (gh[1:2, :] * valid).astype(dtype)
+        if quant:
+            # packed (qg << 16 | qh) words in the grad plane: one row
+            # read instead of two, levels exact in any matmul dtype
+            w = gh_ref[gh_off:gh_off + 1, :]       # [1, Rb] i32
+            g_t = ((w >> 16).astype(jnp.float32) * valid).astype(dtype)
+            h_t = ((w & 0xFFFF).astype(jnp.float32) * valid).astype(dtype)
+        else:
+            gh = jax.lax.bitcast_convert_type(
+                gh_ref[gh_off:gh_off + 2, :], jnp.float32)
+            g_t = (gh[0:1, :] * valid).astype(dtype)
+            h_t = (gh[1:2, :] * valid).astype(dtype)
 
         # unpack this super-chunk's feature code rows from its packed
         # planes: k codes per plane, feature f = plane*k + j at bit
@@ -443,25 +488,29 @@ def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
             * code_bits
         ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fsp, Rb]
         _accum_chunks(ct, g_t, h_t, out_ref, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
-                      bl_bits=bl_bits, dtype=dtype)
+                      bl_bits=bl_bits, dtype=dtype, int_out=quant)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
                                              "code_bits", "grad_plane",
                                              "cap", "dtype",
-                                             "rows_per_block", "interpret"))
+                                             "rows_per_block", "interpret",
+                                             "quant"))
 def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
                             num_cols: int, code_bits: int, grad_plane: int,
                             cap: int, dtype=jnp.float32,
                             rows_per_block: Optional[int] = None,
-                            interpret: bool = False) -> jax.Array:
+                            interpret: bool = False,
+                            quant: bool = False) -> jax.Array:
     """Leaf-window histogram straight off the planar state.
 
     data: [P, R] int32 planar training rows; the window is the lane
     range [start, start+count), read as `cap//Rb + 1` aligned blocks per
     super-chunk of 8 code planes (grid=(CS, nblk) — feature chunks ride
     the grid so the program no longer scales with the column count).
-    Returns [num_cols, num_bins, 2] f32.
+    Returns [num_cols, num_bins, 2] f32 — or int32 when ``quant``, in
+    which case the grad plane holds packed ``(qg << 16) | qh`` level
+    words (ops/quantize.py) and accumulation is exact integer.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -516,10 +565,11 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
         functools.partial(_radix_planar_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
                           bl_bits=bl_bits, dtype=dtype,
                           code_bits=code_bits, gh_off=gh_off,
-                          Rb=Rb, SP=SP),
+                          Rb=Rb, SP=SP, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
-                                       jnp.float32),
+                                       jnp.int32 if quant
+                                       else jnp.float32),
         interpret=interpret,
     )(scal, data, data)
 
@@ -615,6 +665,7 @@ def leaf_histogram(bins_full: jax.Array, perm: jax.Array, start, count,
     ordered grad/hess by the leaf's index range, then histogram."""
     rows, valid = gather_leaf_rows(perm, start, count, capacity)
     b = bins_full[rows]
-    g = jnp.where(valid, grad[rows], 0.0)
-    h = jnp.where(valid, hess[rows], 0.0)
+    zero = jnp.zeros((), grad.dtype)  # int levels must stay int
+    g = jnp.where(valid, grad[rows], zero)
+    h = jnp.where(valid, hess[rows], zero)
     return histogram(b, g, h, num_bins, method=method)

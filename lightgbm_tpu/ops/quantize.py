@@ -1,0 +1,126 @@
+"""Gradient/hessian quantization for integer histogram training.
+
+Reproduces the quantized-training scheme of *Quantized Training of
+Gradient Boosting Decision Trees* (Shi et al., NeurIPS 2022), shipped in
+the reference as ``use_quantized_grad`` (src/boosting/gbdt.cpp +
+src/treelearner/gradient_discretizer.cpp): once per boosting iteration
+the f32 gradients/hessians are scaled by per-iteration constants and
+stochastically rounded to small signed/unsigned integers, the histogram
+kernels accumulate those integers exactly in int32, and the integer
+(sum_grad, sum_hess) pairs are rescaled back to f32 only at split-gain
+evaluation (ops/split.py ``dequantize_hist``).
+
+Level assignment mirrors gradient_discretizer.cpp: with ``num_bins``
+total levels, gradients use the signed range [-(num_bins/2 - 1),
+num_bins/2 - 1] and hessians the unsigned range [0, num_bins - 1]:
+
+    grad_scale = max|g| / (num_bins/2 - 1)      qg = round_sr(g / grad_scale)
+    hess_scale = max h  / (num_bins - 1)        qh = round_sr(h / hess_scale)
+
+``num_bins`` is capped at 64 (config._finalize), which keeps every
+integer-accumulation path exact:
+
+- per-row levels: |qg| <= 31, qh <= 63 — exact even in bfloat16 inputs
+  (8 mantissa bits), so the MXU one-hot matmul kernels keep their 2x
+  bf16 rate;
+- per-chunk partial sums: 131072-row XLA radix chunks x qmax 63 < 2^24,
+  exact in the f32 MXU accumulators before the int32 conversion;
+- whole-dataset sums: 2^31 / 63 > 34M rows per (feature, bin) cell.
+
+Packing: a (qg, qh) pair fits one int32 word, ``(qg << 16) | (qh &
+0xFFFF)``. Because word addition carries the low half into the high
+half only when the low sum overflows 16 bits, a SUM of packed words
+decomposes exactly back into (sum_qg, sum_qh) as long as
+``count * (num_bins - 1) < 2^16`` (``packed_rows_ok``) — the per-leaf
+hist-bits escalation boundary for packed collectives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# one packed (qg, qh) word per row
+PACKED_BYTES_PER_ROW = 4
+
+
+def note_requantize(num_bins: int, passes: int = 1) -> None:
+    """Count a quantization pass in the active telemetry registry
+    (hist.quant_* counters, obs schema minor 2); no-op when telemetry
+    is off."""
+    from ..obs import active
+    reg = active()
+    if reg is not None:
+        reg.inc("hist.quant_requantize_passes", passes)
+        reg.set_gauge("hist.quant_bins", num_bins)
+
+
+def grad_levels(num_bins: int) -> tuple:
+    """(signed grad level max, unsigned hess level max)."""
+    return num_bins // 2 - 1, num_bins - 1
+
+
+def packed_rows_ok(count: int, num_bins: int) -> bool:
+    """True when a packed-word sum over ``count`` rows cannot carry out
+    of the low 16-bit hessian field (sum qh <= count * (num_bins-1))."""
+    return count * (num_bins - 1) < (1 << 16)
+
+
+def quantize_gradients(grad: jax.Array, hess: jax.Array, num_bins: int,
+                       key: jax.Array, stochastic: bool = True,
+                       grad_max=None, hess_max=None):
+    """Per-iteration device quantization pass.
+
+    grad/hess: [n] f32 (pad rows already zeroed). Returns
+    (qg, qh, grad_scale, hess_scale): int32 levels and f32 scalar
+    scales. Scales are floored at a tiny epsilon so an all-zero
+    iteration (converged objective) divides safely; its levels are all
+    zero either way. ``grad_max``/``hess_max`` override the local
+    maxima (sharded learners pmax them first so every shard quantizes
+    on the same grid).
+    """
+    qmax_g, qmax_h = grad_levels(num_bins)
+    if grad_max is None:
+        grad_max = jnp.max(jnp.abs(grad))
+    if hess_max is None:
+        hess_max = jnp.max(hess)
+    gscale = jnp.maximum(grad_max, 1e-35) / qmax_g
+    hscale = jnp.maximum(hess_max, 1e-35) / qmax_h
+    sg = grad / gscale
+    sh = hess / hscale
+    if stochastic:
+        kg, kh = jax.random.split(key)
+        # floor(x + u), u ~ U[0,1): unbiased stochastic rounding
+        sg = jnp.floor(sg + jax.random.uniform(kg, sg.shape))
+        sh = jnp.floor(sh + jax.random.uniform(kh, sh.shape))
+    else:
+        sg = jnp.round(sg)
+        sh = jnp.round(sh)
+    qg = jnp.clip(sg, -qmax_g, qmax_g).astype(jnp.int32)
+    qh = jnp.clip(sh, 0, qmax_h).astype(jnp.int32)
+    return qg, qh, gscale.astype(jnp.float32), hscale.astype(jnp.float32)
+
+
+def pack_gh(qg: jax.Array, qh: jax.Array) -> jax.Array:
+    """[n] int32 packed words: qg in the high 16 bits (sign-carrying),
+    qh in the low 16 (always non-negative, so no borrow on unpack)."""
+    return (qg.astype(jnp.int32) << 16) | (qh.astype(jnp.int32) & 0xFFFF)
+
+
+def unpack_gh(w: jax.Array) -> tuple:
+    """Inverse of pack_gh — also exact on packed-word SUMS while the
+    low field has not overflowed (see packed_rows_ok)."""
+    qh = w & 0xFFFF
+    qg = w >> 16  # arithmetic shift: restores the sign of qg
+    return qg, qh
+
+
+def packed_hist_to_pairs(packed: jax.Array) -> jax.Array:
+    """[..., F, B] summed packed words → [..., F, B, 2] int32 pairs."""
+    qg, qh = unpack_gh(packed)
+    return jnp.stack([qg, qh], axis=-1)
+
+
+def pairs_to_packed_hist(hist: jax.Array) -> jax.Array:
+    """[..., F, B, 2] int32 pairs → [..., F, B] packed words (valid for
+    transport when the hessian sums fit 16 bits)."""
+    return pack_gh(hist[..., 0], hist[..., 1])

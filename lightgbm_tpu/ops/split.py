@@ -106,6 +106,23 @@ def threshold_l1(s, l1):
     return jnp.sign(s) * reg
 
 
+def dequantize_hist(hist: jax.Array, grad_scale, hess_scale) -> jax.Array:
+    """Integer-histogram → f32 rescale at the gain-eval boundary.
+
+    Quantized training (ops/quantize.py) keeps the hist pool, histogram
+    subtraction, and collectives in exact int32 level-sums; this is the
+    ONE place those sums meet float arithmetic — immediately before the
+    split scans above, mirroring the reference's
+    GetGradientsAndHessians unscaling in feature_histogram.hpp.
+
+    hist: [..., 2] int32 (channel 0 = sum qg, 1 = sum qh);
+    grad_scale/hess_scale: f32 scalars of the iteration.
+    """
+    scale = jnp.stack([jnp.asarray(grad_scale, jnp.float32),
+                       jnp.asarray(hess_scale, jnp.float32)])
+    return hist.astype(jnp.float32) * scale
+
+
 def _calc_output(g, h, cnt, cfg: SplitConfig, parent_output, cmin, cmax):
     """CalculateSplittedLeafOutput (feature_histogram.hpp:740-780)."""
     if cfg.lambda_l1 > 0:

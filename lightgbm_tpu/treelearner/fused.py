@@ -57,6 +57,7 @@ from ..io.binning import BIN_CATEGORICAL
 from ..models.tree import Tree
 from ..ops import histogram as H
 from ..ops import plane
+from ..ops import quantize as Q
 from ..ops import split as S
 from ..utils import log
 
@@ -114,6 +115,19 @@ def fused_reject_reason(config: Config, dataset: BinnedDataset,
         # host-loop territory (treelearner/monotone.py)
         return ("monotone_constraints_method=intermediate or "
                 "monotone_penalty > 0")
+    if config.use_quantized_grad:
+        # the quantized pass rounds persistent_grads in-program and
+        # renews leaf values from the raw f32 score/label planes — both
+        # live only on the persistent path. Per-tree fused configs
+        # (bagging/GOSS/RF/DART, multi-class) take the host-loop serial
+        # learner, which quantizes per tree on its own.
+        persist = (objective is not None
+                   and getattr(objective, "persistent_aux", None) is not None
+                   and objective.persistent_aux() is not None
+                   and objective.num_tree_per_iteration == 1)
+        if not persist or config.boosting != "gbdt" or bag_active(config):
+            return ("use_quantized_grad outside the persistent path "
+                    "(bagging/GOSS/RF/DART or a non-pointwise objective)")
     if objective is not None and objective.is_renew_tree_output:
         # the leaf refit runs in-program via _renew_leaf_outputs, which
         # needs the persistent path's label/score planes — reject
@@ -248,6 +262,16 @@ class FusedSerialGrower:
         self._hist_method = H.hist_method(config)
         self._part_method = (os.environ.get("LGBM_TPU_PART", "pallas2")
                              if self._hist_method is not None else "ref")
+        # quantized-gradient training (ops/quantize.py): the persistent
+        # iteration quantizes grads in-program, the grad plane carries
+        # PACKED (qg << 16 | qh) words bitcast through the f32 lanes,
+        # and the hist pool holds exact int32 level-sums. Host-side
+        # per-iteration counter drives the stochastic-rounding keys.
+        self._quant = bool(config.use_quantized_grad)
+        self._quant_iter = 0
+        self._quant_base_key = (
+            jax.random.PRNGKey(config.objective_seed ^ 0x51A7)
+            if self._quant else None)
 
         # planar layout: label/score/weight planes only when the
         # objective can run the persistent in-program loop. Codes pack
@@ -566,10 +590,10 @@ class FusedSerialGrower:
                                    compute_score_update)
 
     def _entry_train_iter(self, tables, data, feature_mask, shrinkage,
-                          bias, n_valid):
+                          bias, n_valid, key=None):
         with self._bind_tables(tables):
             return self._train_iter(data, feature_mask, shrinkage, bias,
-                                    n_valid=n_valid)
+                                    n_valid=n_valid, key=key)
 
     def _entry_traverse(self, tables, ta, bins):
         with self._bind_tables(tables):
@@ -592,8 +616,14 @@ class FusedSerialGrower:
         f32s = aval((), jnp.float32)
         i32s = aval((), jnp.int32)
         if self.persistent_capable and self._score_from_partition:
-            self._iter_entry.add_spec(
-                (t_avals, data_aval, mask_aval, f32s, f32s, i32s))
+            if self._quant:
+                key_aval = aval((2,), jnp.uint32)
+                self._iter_entry.add_spec(
+                    (t_avals, data_aval, mask_aval, f32s, f32s, i32s,
+                     key_aval))
+            else:
+                self._iter_entry.add_spec(
+                    (t_avals, data_aval, mask_aval, f32s, f32s, i32s))
             self._sync_entry.add_spec((data_aval,))
         elif self._score_from_partition:
             n = self.actual_rows
@@ -646,6 +676,13 @@ class FusedSerialGrower:
             return x
         return jax.lax.psum(x, self.psum_axis)
 
+    def _psum_max(self, x):
+        """Cross-shard max — identity on one chip (the quantization
+        scales must agree across shards before any int32 hist psum)."""
+        if self.psum_axis is None:
+            return x
+        return jax.lax.pmax(x, self.psum_axis)
+
     def _window_hist(self, b, g, h):
         """Histogram of bin codes with masked weights; EFB bundle
         columns are gathered back to per-feature space (FixHistogram
@@ -688,7 +725,7 @@ class FusedSerialGrower:
                         data, start, count, num_bins=nbins,
                         num_cols=Ly.num_cols, code_bits=Ly.code_bits,
                         grad_plane=Ly.grad, cap=cap, dtype=dtype,
-                        rows_per_block=rb_br)
+                        rows_per_block=rb_br, quant=self._quant)
                     return self._hist_from_groups(ghist)
                 rs = jnp.clip(jnp.asarray(start, jnp.int32), 0, R - cap)
                 codes, gh = plane.window_rowmajor(data, self.layout, rs,
@@ -696,8 +733,18 @@ class FusedSerialGrower:
                 off = jnp.asarray(start, jnp.int32) - rs
                 pos = jnp.arange(cap, dtype=jnp.int32)
                 valid = (pos >= off) & (pos < off + count)
-                g = jnp.where(valid, gh[:, 0], 0.0)
-                h = jnp.where(valid, gh[:, 1], 0.0)
+                if self._quant:
+                    # the grad plane carries packed (qg, qh) words
+                    # bitcast through the f32 lanes — unpack to int32
+                    # levels so the hist kernels take their exact
+                    # integer-accumulation paths
+                    qg, qh = Q.unpack_gh(plane.f32_as_i32(gh[:, 0]))
+                    zero = jnp.zeros((), jnp.int32)
+                    g = jnp.where(valid, qg, zero)
+                    h = jnp.where(valid, qh, zero)
+                else:
+                    g = jnp.where(valid, gh[:, 0], 0.0)
+                    h = jnp.where(valid, gh[:, 1], 0.0)
                 return self._window_hist(codes, g, h)
             return fn
 
@@ -726,12 +773,16 @@ class FusedSerialGrower:
         return data, nleft
 
     def _scan_leaf(self, hist, sum_g, sum_h, count, output, cmin, cmax,
-                   feature_mask):
+                   feature_mask, qscales=None):
         """Best split of one leaf from its pooled histogram; categorical
         features go through the merged numerical+categorical scan and
         materialize their left-category bitset HERE (the device
         analogue of serial.py _cat_bins), so the loop state only
-        carries [8] words per leaf, not the full sorted order."""
+        carries [8] words per leaf, not the full sorted order.
+        ``qscales``: (grad_scale, hess_scale) when the pool holds int32
+        level-sums — the scans themselves always run in f32."""
+        if qscales is not None:
+            hist = S.dequantize_hist(hist, qscales[0], qscales[1])
         if self.any_categorical:
             res = S.best_split(hist, self.meta, self.split_cfg, sum_g,
                                sum_h, count, output, cmin, cmax,
@@ -787,14 +838,14 @@ class FusedSerialGrower:
         return jnp.stack(words)
 
     def _scan_two_leaves(self, hist2, sum_g2, sum_h2, count2, output2,
-                         cmin2, cmax2, feature_mask2):
+                         cmin2, cmax2, feature_mask2, qscales=None):
         """Both children's best splits from one vmapped scan (halves the
         per-split scan kernel count vs two sequential _scan_leaf calls).
         feature_mask2: [2, F] — per-child masks (identical rows unless
         feature_fraction_bynode is active)."""
         res2 = jax.vmap(
             lambda h, sg, sh, c, o, lo, hi, m: self._scan_leaf(
-                h, sg, sh, c, o, lo, hi, m)
+                h, sg, sh, c, o, lo, hi, m, qscales=qscales)
         )(hist2, sum_g2, sum_h2, count2, output2, cmin2, cmax2,
           feature_mask2)
         first = {k: v[0] for k, v in res2.items()}
@@ -802,25 +853,35 @@ class FusedSerialGrower:
         return first, second
 
     # ------------------------------------------------------------------
-    def _grow_tree_core(self, data, bag_cnt, feature_mask):
+    def _grow_tree_core(self, data, bag_cnt, feature_mask, qscales=None):
         """The while_loop tree builder over planar data. Returns
         (tree arrays dict, final FusedTreeState). feature_mask: [F]
         per-tree mask, or [2L, F] per-scan-event masks (see
-        feature_masks_for_tree) — the rank is a static branch."""
+        feature_masks_for_tree) — the rank is a static branch.
+        ``qscales``: (grad_scale, hess_scale) traced scalars when the
+        grad plane carries packed quantized levels; the hist pool and
+        the subtraction then stay in exact int32, and every per-leaf
+        f32 state field (sums, outputs) is dequantized at the scan
+        boundary."""
         L = self.num_leaves
         F, B = self.num_features, self.max_num_bin
         f32, i32 = jnp.float32, jnp.int32
+        quant = qscales is not None
         bynode = feature_mask.ndim == 2
         root_mask = feature_mask[0] if bynode else feature_mask
 
         root_hist = self._psum(self._leaf_hist_switch(data, jnp.int32(0),
                                                       bag_cnt))
         bag_cnt_g = self._psum(jnp.asarray(bag_cnt, i32))
-        sum_g = jnp.sum(root_hist[0, :, 0])
-        sum_h = jnp.sum(root_hist[0, :, 1])
+        if quant:
+            sum_g = jnp.sum(root_hist[0, :, 0]).astype(f32) * qscales[0]
+            sum_h = jnp.sum(root_hist[0, :, 1]).astype(f32) * qscales[1]
+        else:
+            sum_g = jnp.sum(root_hist[0, :, 0])
+            sum_h = jnp.sum(root_hist[0, :, 1])
         root_best = self._scan_leaf(root_hist, sum_g, sum_h, bag_cnt_g,
                                     f32(0.0), f32(-jnp.inf), f32(jnp.inf),
-                                    root_mask)
+                                    root_mask, qscales=qscales)
 
         def arr(val, dtype=f32):
             return jnp.full((L,), val, dtype)
@@ -850,9 +911,10 @@ class FusedSerialGrower:
             best_rout=arr(0.0).at[0].set(root_best["rout"]),
             best_cat=arr(False, bool).at[0].set(root_best["cat"]),
             best_bits=jnp.zeros((L, 8), i32).at[0].set(root_best["bits"]),
-            hist_pool=(jnp.zeros((L, F, B, 2), f32).at[0].set(root_hist)
+            hist_pool=(jnp.zeros((L, F, B, 2), i32 if quant else f32)
+                       .at[0].set(root_hist)
                        if self._use_hist_pool
-                       else jnp.zeros((1, 1, 1, 2), f32)),
+                       else jnp.zeros((1, 1, 1, 2), i32 if quant else f32)),
             t_feature=jnp.zeros((L - 1,), i32),
             t_thr=jnp.zeros((L - 1,), i32),
             t_dl=jnp.zeros((L - 1,), bool),
@@ -1011,7 +1073,7 @@ class FusedSerialGrower:
                 jnp.stack([nleft_g, nright_g]),
                 jnp.stack([lout, rout]),
                 jnp.stack([lcmin, rcmin]),
-                jnp.stack([lcmax, rcmax]), mask2)
+                jnp.stack([lcmax, rcmax]), mask2, qscales=qscales)
 
             def upd(a, key, cast=lambda x: x):
                 return a.at[leaf].set(cast(bl[key])).at[new_leaf].set(cast(br[key]))
@@ -1058,6 +1120,10 @@ class FusedSerialGrower:
                 feat = f_feat[k]
                 thr = f_thr[k]
                 hist = st.hist_pool[leaf]            # [F, B, 2]
+                if quant:
+                    # same int->f32 boundary as the gain scans: the
+                    # forced-split sums below are all-f32 arithmetic
+                    hist = S.dequantize_hist(hist, qscales[0], qscales[1])
                 h = jnp.sum(jnp.where(
                     (jnp.arange(F, dtype=i32) == feat)[:, None, None],
                     hist, 0.0), axis=0)              # [B, 2], no gather
@@ -1338,6 +1404,53 @@ class FusedSerialGrower:
             out = jnp.where(interp, out_i, v2)
         return jnp.where(valid, out, 0.0).astype(jnp.float32)
 
+    def _renew_quant_leaves(self, st: FusedTreeState, n):
+        """Leaf values from the RAW f32 gradient/hessian sums after a
+        quantized-gradient tree search (the reference's
+        RenewIntGradTreeOutput, gradient_discretizer.cpp) — the tree
+        STRUCTURE keeps the quantized split decisions, the leaf OUTPUTS
+        drop the rounding error. Raw grads come from persistent_grads on
+        the final state's score/label planes (values unchanged by the
+        growth loop, only lane-permuted with the rows), then per-leaf
+        window sums via the one-cumsum trick of _renew_leaf_outputs."""
+        Ly = self.layout
+        lanes = jnp.arange(Ly.num_lanes, dtype=jnp.int32)
+        realm = lanes < jnp.asarray(n, jnp.int32)
+        score = plane.get_f32(st.data, Ly.score)
+        label = plane.get_f32(st.data, Ly.label)
+        weight = plane.get_f32(st.data, Ly.weight) if Ly.weight >= 0 \
+            else None
+        g, h = self.objective.persistent_grads(score, label, weight)
+        g = jnp.where(realm, g, 0.0)
+        h = jnp.where(realm, h, 0.0)
+
+        ends = st.leaf_start + st.leaf_count
+        sidx = jnp.maximum(st.leaf_start, 1) - 1
+
+        def seg_sums(c):
+            # per-leaf window sums of a [R] vector via one cumsum;
+            # shard-locally empty windows zeroed BEFORE the psum (see
+            # _renew_leaf_outputs)
+            cs = jnp.cumsum(c)
+            lo = jnp.where(st.leaf_start > 0, cs[sidx], 0.0)
+            raw = cs[jnp.maximum(ends, 1) - 1] - lo
+            return self._psum(jnp.where(st.leaf_count > 0, raw, 0.0))
+
+        sg = seg_sums(g)
+        sh = seg_sums(h)
+        cfg = self.split_cfg
+        # CalculateSplittedLeafOutput's basic form (threshold_l1 is the
+        # identity at lambda_l1=0); the monotone bounds carried in the
+        # state still clamp the renewed values
+        out = -S.threshold_l1(sg, cfg.lambda_l1) \
+            / (sh + cfg.lambda_l2 + S.K_EPSILON)
+        if cfg.max_delta_step > 0:
+            out = jnp.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+        out = jnp.clip(out, st.leaf_cmin, st.leaf_cmax)
+        lid = jnp.arange(self.num_leaves, dtype=jnp.int32)
+        valid = (lid < st.n_leaves) & (st.leaf_count_g > 0)
+        return jnp.where(valid, out, st.leaf_output).astype(jnp.float32)
+
     # ------------------------------------------------------------------
     def _grow_tree(self, codes_planes, grad, hess, perm, bag_cnt,
                    feature_mask, bins_rowmajor=None,
@@ -1411,12 +1524,14 @@ class FusedSerialGrower:
         return data
 
     def _train_iter(self, data, feature_mask, shrinkage, bias,
-                    n_valid=None):
+                    n_valid=None, key=None):
         """One full boosting iteration in ONE program: gradients from
         the in-state score, tree growth, and the score update — all in
         leaf-permuted lane order (GBDT::TrainOneIter, gbdt.cpp:337,
         minus the host loop). ``n_valid`` overrides the static row
-        count (traced, for per-shard row counts under shard_map)."""
+        count (traced, for per-shard row counts under shard_map).
+        ``key``: per-iteration PRNG key for the stochastic rounding of
+        the quantized pass (required when use_quantized_grad)."""
         Ly = self.layout
         n = jnp.int32(Ly.num_rows) if n_valid is None \
             else jnp.asarray(n_valid, jnp.int32)
@@ -1429,9 +1544,29 @@ class FusedSerialGrower:
         g, h = self.objective.persistent_grads(score, label, weight)
         g = jnp.where(realm, g, 0.0)
         h = jnp.where(realm, h, 0.0)
-        data = plane.set_gh(data, Ly, g, h)
+        qscales = None
+        if self._quant:
+            # per-iteration device quantization pass: the grad plane
+            # carries the packed (qg << 16 | qh) words bitcast through
+            # the f32 lanes, the hess plane zeros (the kernels unpack
+            # both levels from the one word). Scales psum-max across
+            # shards so every shard quantizes on the same grid and the
+            # int32 histogram psums stay coherent.
+            gmax = self._psum_max(jnp.max(jnp.abs(g)))
+            hmax = self._psum_max(jnp.max(h))
+            qg, qh, gs, hs = Q.quantize_gradients(
+                g, h, self.config.num_grad_quant_bins, key,
+                stochastic=self.config.stochastic_rounding,
+                grad_max=gmax, hess_max=hmax)
+            qscales = (gs, hs)
+            packed = plane.i32_as_f32(Q.pack_gh(qg, qh))
+            data = plane.set_gh(data, Ly, packed,
+                                jnp.zeros_like(packed))
+        else:
+            data = plane.set_gh(data, Ly, g, h)
 
-        ta, st = self._grow_tree_core(data, n, feature_mask)
+        ta, st = self._grow_tree_core(data, n, feature_mask,
+                                      qscales=qscales)
 
         renew = (self.objective.persistent_renew_spec()
                  if self.objective is not None else None)
@@ -1441,6 +1576,15 @@ class FusedSerialGrower:
             alpha, weighted = renew
             ta = dict(ta, leaf_value=self._renew_leaf_outputs(
                 st, n, alpha, weighted))
+        elif self._quant and self.config.quant_train_renew_leaf:
+            # RenewIntGradTreeOutput (gradient_discretizer.cpp): leaf
+            # values recomputed from the RAW f32 gradient sums so the
+            # rounding error of the quantized split search never enters
+            # the model output. The raw grads are recomputed from the
+            # (permuted, but value-unchanged) score/label planes of the
+            # FINAL state — pre-growth g/h are in pre-partition lane
+            # order and would pair with the wrong windows.
+            ta = dict(ta, leaf_value=self._renew_quant_leaves(st, n))
 
         vals = ta["leaf_value"] * shrinkage
         add = self._score_add_by_pos(st, vals.astype(jnp.float32))
@@ -1448,12 +1592,27 @@ class FusedSerialGrower:
         data = plane.set_f32(st.data, Ly.score, score2)
         return data, ta
 
+    def _next_quant_keys(self, k: int):
+        """[k, 2] u32 per-iteration stochastic-rounding keys from the
+        host-side iteration counter (deterministic across runs; each
+        boosting iteration gets a fresh fold_in of the base key)."""
+        Q.note_requantize(self.config.num_grad_quant_bins, k)
+        start = self._quant_iter
+        self._quant_iter += k
+        return jax.vmap(
+            lambda i: jax.random.fold_in(self._quant_base_key, i)
+        )(jnp.arange(start, start + k, dtype=jnp.uint32))
+
     def train_iter_persistent(self, data, shrinkage, bias, mask=None):
         if mask is None:
             mask = self.feature_masks_for_tree()
-        return self._iter_jit(self._tables(), data, mask,
-                              jnp.float32(shrinkage), jnp.float32(bias),
-                              jnp.int32(self.actual_rows))
+        args = (self._tables(), data, mask, jnp.float32(shrinkage),
+                jnp.float32(bias), jnp.int32(self.actual_rows))
+        if self._quant:
+            # extra key arg ONLY under quant: the default path's call
+            # arity (and so its cached executables) stays identical
+            return self._iter_jit(*args, self._next_quant_keys(1)[0])
+        return self._iter_jit(*args)
 
     def _iters_scan_jit_build(self, k: int):
         """K boosting iterations in ONE dispatch: lax.scan over the
@@ -1461,14 +1620,18 @@ class FusedSerialGrower:
         the single-iteration program). Exists because each dispatch over
         the remote-accelerator tunnel costs tens of ms of host latency —
         at K=10 the per-iteration dispatch overhead drops 10x."""
-        def run(tables, data, masks, shrinkage, n_valid):
+        quant = self._quant
+
+        def run(tables, data, masks, shrinkage, n_valid, keys=None):
             with self._bind_tables(tables):
-                def step(d, mask):
+                def step(d, xs):
+                    mask, key = xs if quant else (xs, None)
                     d, ta = self._train_iter(d, mask, shrinkage,
                                              jnp.float32(0.0),
-                                             n_valid=n_valid)
+                                             n_valid=n_valid, key=key)
                     return d, ta
-                return jax.lax.scan(step, data, masks, length=k)
+                xs = (masks, keys) if quant else masks
+                return jax.lax.scan(step, data, xs, length=k)
 
         from ..obs import instrument_kernel
         if self._mgr is not None:
@@ -1489,9 +1652,11 @@ class FusedSerialGrower:
             self._iters_jit_k = {}
         if k not in self._iters_jit_k:
             self._iters_jit_k[k] = self._iters_scan_jit_build(k)
-        return self._iters_jit_k[k](self._tables(), data, masks,
-                                    jnp.float32(shrinkage),
-                                    jnp.int32(self.actual_rows))
+        args = (self._tables(), data, masks, jnp.float32(shrinkage),
+                jnp.int32(self.actual_rows))
+        if self._quant:
+            return self._iters_jit_k[k](*args, self._next_quant_keys(k))
+        return self._iters_jit_k[k](*args)
 
     def _sync_scores(self, data):
         n = self.layout.num_rows
@@ -1712,6 +1877,9 @@ class PendingTree:
         self.resolver = resolver
         self.pending_shrinkage = 1.0
         self.pending_bias = 0.0
+        # host-cached leaf count (GBDT._batched_tree_stats): immutable
+        # once the tree is grown, so one batched fetch serves forever
+        self._n_leaves_host: Optional[int] = None
 
     @property
     def tree_arrays(self) -> Dict:

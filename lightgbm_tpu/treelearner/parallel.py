@@ -42,6 +42,7 @@ from ..models.tree import Tree
 from ..network import collective_span
 from ..obs import instrument_kernel
 from ..ops import histogram as H
+from ..ops import quantize as Q
 from ..ops import split as S
 from ..ops.partition import next_capacity
 from ..ops.partition import _decision_go_left
@@ -119,8 +120,12 @@ class DataParallelTreeGrower(SerialTreeGrower):
         self._spec_rows = NamedSharding(self.mesh, P("data", None))
 
     # -- sharded kernels ------------------------------------------------
+    # the voting override's local vote scan needs the per-tree
+    # dequantization scales as traced args; this learner's psum does not
+    _hist_takes_scales = False
+
     @functools.lru_cache(maxsize=64)
-    def _hist_fn_sharded(self, capacity: int):
+    def _hist_fn_sharded(self, capacity: int, packed: bool = False):
         B = self.max_num_bin
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
@@ -142,9 +147,20 @@ class DataParallelTreeGrower(SerialTreeGrower):
             # ReduceScatter+Allgather of the reference (:169) collapses
             # to one ICI all-reduce; feature-sharded scan is a later
             # optimization once profiling justifies psum_scatter
-            hist = jax.lax.psum(h, "data")
+            if packed:
+                # quantized path, small leaf: both int32 level-sum
+                # lanes of every cell fit 16 bits (Q.packed_rows_ok
+                # checked host-side), so one packed [*, B] word psum
+                # moves HALF the bytes of the [*, B, 2] reduction —
+                # the integer-collective saving of the quantized
+                # training paper
+                hist = Q.packed_hist_to_pairs(
+                    jax.lax.psum(Q.pairs_to_packed_hist(h), "data"))
+            else:
+                hist = jax.lax.psum(h, "data")
             # exact global leaf sums (root sums in the reference come
-            # from an Allreduce of (count, Σg, Σh) tuples, :126-152)
+            # from an Allreduce of (count, Σg, Σh) tuples, :126-152);
+            # int32 level sums under quantized training (host rescales)
             sg = jax.lax.psum(jnp.sum(h[0, :, 0]), "data")
             sh = jax.lax.psum(jnp.sum(h[0, :, 1]), "data")
             if efb_hist is not None:
@@ -152,19 +168,23 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 # under parallel learners): the bundle-space histogram
                 # is psum'd, then gathered to per-feature space with the
                 # mfb FixHistogram reconstruction — which needs GLOBAL
-                # totals, hence after the psum
+                # totals, hence after the psum (dtype-preserving, so the
+                # quantized int32 reconstruction stays exact)
                 from ..io.efb import per_feature_hist
                 total = hist[0].sum(axis=0)
                 hist = per_feature_hist(hist, efb_hist, total[0], total[1])
             return hist, sg, sh
-        # the psum moves one [F, B, 2] f32 histogram per call
+        # the psum moves one [F, B, 2] histogram per call (f32, or int32
+        # level-sums under quantized training; [F, B] packed words when
+        # the leaf is small enough)
+        psum_bytes = self.num_features * B * (2 if packed else 4) * 2
         from ..compile import get_manager
         return instrument_kernel(
             get_manager().jit_entry(
-                f"data_parallel/leaf_histogram_c{capacity}", fn),
+                f"data_parallel/leaf_histogram_c{capacity}"
+                + ("_packed" if packed else ""), fn),
             "hist", name="data_parallel/leaf_histogram",
-            collective=("hist_psum",
-                        self.num_features * B * 2 * 4))
+            collective=("hist_psum", psum_bytes))
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn_sharded(self, capacity: int):
@@ -190,6 +210,30 @@ class DataParallelTreeGrower(SerialTreeGrower):
             get_manager().jit_entry(
                 f"data_parallel/partition_leaf_c{capacity}", fn),
             "partition", name="data_parallel/partition_leaf")
+
+    def _hist_call(self, cap: int, total_count: int, *args):
+        """Histogram + psum at the right integer width: under quantized
+        training, leaves whose GLOBAL row count keeps every packed
+        16-bit lane sum exact ride the halved packed-word collective;
+        larger leaves escalate to the unpacked [F, B, 2] int32 psum
+        (the per-leaf hist-bits escalation of the reference's
+        gradient_discretizer)."""
+        packed = False
+        if self._qscales is not None:
+            from ..obs import active as obs_active
+            packed = Q.packed_rows_ok(int(total_count),
+                                      self.config.num_grad_quant_bins)
+            reg = obs_active()
+            if reg is not None:
+                if packed:
+                    reg.inc("hist.quant_packed_bytes",
+                            self.num_features * self.max_num_bin * 4)
+                else:
+                    reg.inc("hist.quant_overflow_escalations")
+        fn = self._hist_fn_sharded(cap, packed)
+        if self._qscales is not None and self._hist_takes_scales:
+            return fn(*args, *self._qscales)
+        return fn(*args)
 
     # -- grower ---------------------------------------------------------
     def grow(self, grad: jax.Array, hess: jax.Array, perm: jax.Array,
@@ -219,8 +263,33 @@ class DataParallelTreeGrower(SerialTreeGrower):
             grad_np = np.where(mask, grad_np, 0.0)
             hess_np = np.where(mask, hess_np, 0.0)
             perm_np, counts0 = shard_bag_permutation(perm, num_data, d, rps)
-        g_sh = jax.device_put(jnp.asarray(grad_np.reshape(d, rps)), self._spec_rows)
-        h_sh = jax.device_put(jnp.asarray(hess_np.reshape(d, rps)), self._spec_rows)
+        self._qscales = None
+        raw_g_sh = raw_h_sh = None
+        if self._quant:
+            # one quantization pass per tree (bag-masked raw grads in,
+            # int32 levels out); every sharded histogram and its psum
+            # then run in exact level space, and the host keeps leaf
+            # sums in dequantized f32 units
+            Q.note_requantize(cfg.num_grad_quant_bins)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
+                self._quant_tree_idx)
+            self._quant_tree_idx += 1
+            qg, qh, gs, hs = Q.quantize_gradients(
+                jnp.asarray(grad_np), jnp.asarray(hess_np),
+                cfg.num_grad_quant_bins, key, cfg.stochastic_rounding)
+            self._qscales = (gs, hs)
+            self._qscales_host = (float(gs), float(hs))
+            if cfg.quant_train_renew_leaf:
+                raw_g_sh = jax.device_put(
+                    jnp.asarray(grad_np.reshape(d, rps)), self._spec_rows)
+                raw_h_sh = jax.device_put(
+                    jnp.asarray(hess_np.reshape(d, rps)), self._spec_rows)
+            g_sh = jax.device_put(qg.reshape(d, rps), self._spec_rows)
+            h_sh = jax.device_put(qh.reshape(d, rps), self._spec_rows)
+        else:
+            g_sh = jax.device_put(jnp.asarray(grad_np.reshape(d, rps)), self._spec_rows)
+            h_sh = jax.device_put(jnp.asarray(hess_np.reshape(d, rps)), self._spec_rows)
         perm_sh = jax.device_put(jnp.asarray(perm_np), self._spec_rows)
 
         tree = Tree(cfg.num_leaves,
@@ -230,9 +299,14 @@ class DataParallelTreeGrower(SerialTreeGrower):
 
         starts0 = np.zeros(d, dtype=np.int32)
         cap = next_capacity(int(counts0.max()))
-        hist, sg, sh = self._hist_fn_sharded(cap)(
+        hist, sg, sh = self._hist_call(
+            cap, int(counts0.sum()),
             self.bins_sharded, perm_sh, jnp.asarray(starts0),
             jnp.asarray(counts0), g_sh, h_sh)
+        if self._qscales is not None:
+            # int32 level sums -> dequantized f32 leaf totals
+            sg = float(sg) * self._qscales_host[0]
+            sh = float(sh) * self._qscales_host[1]
         root = _Leaf(starts0, counts0, float(sg), float(sh), 0.0, 0)
         root.hist = hist
         root.best = self._compute_best_dp(root, tree_mask,
@@ -254,7 +328,52 @@ class DataParallelTreeGrower(SerialTreeGrower):
             perm_sh = self._split_leaf_dp(tree, leaves, best_leaf, perm_sh,
                                           g_sh, h_sh, tree_mask, rand_thr)
         self.last_perm = perm_sh
+        if self._quant and cfg.quant_train_renew_leaf:
+            self._renew_leaf_values_dp(tree, leaves, perm_sh,
+                                       raw_g_sh, raw_h_sh)
         return tree
+
+    def _renew_leaf_values_dp(self, tree: Tree, leaves: Dict[int, _Leaf],
+                              perm_sh, g_sh, h_sh) -> None:
+        """Sharded mirror of SerialTreeGrower._renew_leaf_values: leaf
+        outputs refit from the EXACT f32 grad/hess sums after quantized
+        growth. One leaf-ordered cumsum per shard; only the [L, D]
+        window-boundary prefix values transfer to the host, where the
+        cross-shard sums and the output formula run in f64."""
+        items = [(lid, lf) for lid, lf in leaves.items()
+                 if int(np.sum(lf.count)) > 0]
+        if not items:
+            return
+        cg = jnp.cumsum(jnp.take_along_axis(g_sh, perm_sh, axis=1), axis=1)
+        ch = jnp.cumsum(jnp.take_along_axis(h_sh, perm_sh, axis=1), axis=1)
+        starts = np.asarray([lf.start for _, lf in items])      # [L, D]
+        counts = np.asarray([lf.count for _, lf in items])      # [L, D]
+        ends = starts + counts - 1
+        los = starts - 1
+        dd = jnp.arange(self.num_shards, dtype=jnp.int32)[None, :]
+        e_idx = jnp.asarray(np.maximum(ends, 0), jnp.int32)
+        lo_idx = jnp.asarray(np.maximum(los, 0), jnp.int32)
+        ge, he, gl, hl = jax.device_get(
+            (cg[dd, e_idx], ch[dd, e_idx], cg[dd, lo_idx], ch[dd, lo_idx]))
+        has = counts > 0
+        has_lo = los >= 0
+        sum_g = np.sum(np.where(
+            has, np.asarray(ge, np.float64) - np.where(has_lo, gl, 0.0),
+            0.0), axis=1)
+        sum_h = np.sum(np.where(
+            has, np.asarray(he, np.float64) - np.where(has_lo, hl, 0.0),
+            0.0), axis=1)
+        cfg = self.config
+        for (lid, lf), g, h in zip(items, sum_g, sum_h):
+            if cfg.lambda_l1 > 0:
+                g = np.sign(g) * max(abs(g) - cfg.lambda_l1, 0.0)
+            out = -g / (h + cfg.lambda_l2 + S.K_EPSILON)
+            if cfg.max_delta_step > 0:
+                out = float(np.clip(out, -cfg.max_delta_step,
+                                    cfg.max_delta_step))
+            if self.use_monotone:
+                out = float(np.clip(out, lf.cmin, lf.cmax))
+            tree.leaf_value[lid] = float(out)
 
     def _compute_best_dp(self, leaf: _Leaf, tree_mask, branch_features,
                          rand_thr):
@@ -331,17 +450,20 @@ class DataParallelTreeGrower(SerialTreeGrower):
         lt, rt = int(lc.sum()), int(rc.sum())
         smaller, larger = (left, right) if lt <= rt else (right, left)
         scap = next_capacity(max(int(np.max(smaller.count)), 1))
-        smaller.hist, _, _ = self._hist_fn_sharded(scap)(
+        smaller.hist, _, _ = self._hist_call(
+            scap, min(lt, rt),
             self.bins_sharded, new_perm, jnp.asarray(smaller.start),
             jnp.asarray(smaller.count), g_sh, h_sh)
         if self.supports_hist_subtraction:
+            # exact in int32 level space under quantized training
             larger.hist = leaf.hist - smaller.hist
         else:
             # voting mode: each reduction round selects its own feature
             # subset, so parent/child histograms are not subtractable —
             # compute the larger child directly (its own vote round)
             lcap = next_capacity(max(int(np.max(larger.count)), 1))
-            larger.hist, _, _ = self._hist_fn_sharded(lcap)(
+            larger.hist, _, _ = self._hist_call(
+                lcap, max(lt, rt),
                 self.bins_sharded, new_perm, jnp.asarray(larger.start),
                 jnp.asarray(larger.count), g_sh, h_sh)
         leaf.hist = None
@@ -374,9 +496,12 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
     """
 
     supports_hist_subtraction = False
+    # the local vote scan evaluates real f32 gains, so the quantized
+    # path must pass the per-tree scales into the sharded program
+    _hist_takes_scales = True
 
     @functools.lru_cache(maxsize=64)
-    def _hist_fn_sharded(self, capacity: int):
+    def _hist_fn_sharded(self, capacity: int, packed: bool = False):
         B = self.max_num_bin
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
@@ -385,14 +510,21 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
         meta = self.meta
         cfg = self.split_cfg
         method = H.hist_method(self.config)
+        quant = self._quant
+        row_specs = (P("data", None, None), P("data", None), P("data"),
+                     P("data"), P("data", None), P("data", None))
+        in_specs = row_specs + ((P(), P()) if quant else ())
 
-        @jax.jit
-        @functools.partial(
-            shard_map, mesh=mesh, check_vma=False,
-            in_specs=(P("data", None, None), P("data", None), P("data"),
-                      P("data"), P("data", None), P("data", None)),
-            out_specs=P())
-        def fn(bins, perm, start, count, grad, hess):
+        def reduce_hist(h):
+            # the big collective: packed [*, B] words (half bytes) when
+            # the leaf's global count keeps 16-bit lane sums exact,
+            # else the plain [*, B, 2] (f32, or int32 level) psum
+            if packed:
+                return Q.packed_hist_to_pairs(
+                    jax.lax.psum(Q.pairs_to_packed_hist(h), "data"))
+            return jax.lax.psum(h, "data")
+
+        def body(bins, perm, start, count, grad, hess, gs=None, hs=None):
             h = H.leaf_histogram(bins[0], perm[0], start[0], count[0],
                                  grad[0], hess[0], capacity,
                                  Bg if efb_hist is not None else B,
@@ -415,8 +547,18 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
                 max_delta_step=cfg.max_delta_step, path_smooth=cfg.path_smooth)
             sg = jnp.sum(h[0, :, 0])
             sh_ = jnp.sum(h[0, :, 1])
-            res = S.numerical_split_scan(h, meta, local_cfg, sg, sh_,
-                                         count[0], 0.0, -jnp.inf, jnp.inf)
+            if quant:
+                # the vote scan runs on the dequantized LOCAL histogram
+                # (gains are regularized, so level-space scans would
+                # mix units); the collectives below stay integer
+                h_scan = S.dequantize_hist(h, gs, hs)
+                sg_scan = sg.astype(jnp.float32) * gs
+                sh_scan = sh_.astype(jnp.float32) * hs
+            else:
+                h_scan, sg_scan, sh_scan = h, sg, sh_
+            res = S.numerical_split_scan(h_scan, meta, local_cfg, sg_scan,
+                                         sh_scan, count[0], 0.0,
+                                         -jnp.inf, jnp.inf)
             gains = jnp.where(jnp.isfinite(res["gain"]), res["gain"], -jnp.inf)
             f_total = gains.shape[0]
             k = min(top_k, f_total)
@@ -429,28 +571,41 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             sg_true = jax.lax.psum(sg, "data")
             sh_true = jax.lax.psum(sh_, "data")
             if k2 >= f_total:
-                return jax.lax.psum(h, "data"), sg_true, sh_true
+                return reduce_hist(h), sg_true, sh_true
             # the vote tally is replicated after its psum, so every
             # shard computes the SAME selected set; only the selected
             # features' histogram slab rides ICI — [2k, B, 2] instead of
             # [F, B, 2], the PV-Tree saving (CopyLocalHistogram :185 +
             # ReduceScatter of selected buffers :343)
             _, selected = jax.lax.top_k(votes, k2)
-            h_sel = jax.lax.psum(h[selected], "data")  # [2k, B, 2]
+            h_sel = reduce_hist(h[selected])           # [2k, B, 2]
             hist_global = jnp.zeros_like(h).at[selected].set(h_sel)
             # non-selected features keep zero histograms; the replicated
             # scan will simply not pick them
             return hist_global, sg_true, sh_true
+
+        if quant:
+            def fn_args(bins, perm, start, count, grad, hess, gs, hs):
+                return body(bins, perm, start, count, grad, hess, gs, hs)
+        else:
+            def fn_args(bins, perm, start, count, grad, hess):
+                return body(bins, perm, start, count, grad, hess)
+        fn = jax.jit(functools.partial(
+            shard_map, mesh=mesh, check_vma=False,
+            in_specs=in_specs, out_specs=P())(fn_args))
         # ICI traffic per call: the [F] vote tally + the selected
-        # [<=2k, B, 2] histogram slab (full [F, B, 2] when 2k >= F)
+        # [<=2k, B, 2] histogram slab (full [F, B, 2] when 2k >= F;
+        # halved when packed)
         k2_est = min(2 * top_k, self.num_features)
         from ..compile import get_manager
         return instrument_kernel(
             get_manager().jit_entry(
-                f"voting_parallel/leaf_histogram_c{capacity}", fn),
+                f"voting_parallel/leaf_histogram_c{capacity}"
+                + ("_packed" if packed else ""), fn),
             "hist", name="voting_parallel/leaf_histogram",
             collective=("voting_psum",
-                        self.num_features * 4 + k2_est * B * 2 * 4))
+                        self.num_features * 4
+                        + k2_est * B * (1 if packed else 2) * 4))
 
 
 class FeatureParallelTreeGrower(SerialTreeGrower):
@@ -565,48 +720,82 @@ class FusedDataParallelGrower(FusedSerialGrower):
             data, NamedSharding(self.mesh, P(None, "data")))
 
     # -- sharded iteration ---------------------------------------------
+    # NOTE on quantized training: the in-graph per-split child-histogram
+    # psum stays at the unpacked [F, B, 2] int32 width — leaf counts are
+    # TRACED inside the while_loop, so the packed/unpacked choice cannot
+    # branch per leaf the way the host-loop learner's _hist_call does.
+    # The quantization scales pmax across shards before packing (see
+    # FusedSerialGrower._train_iter), so the int32 sums stay coherent.
     def train_iter_persistent(self, data, shrinkage, bias, mask=None):
         if mask is None:
             mask = self.feature_masks_for_tree()
+        quant = self._quant
         if self._iter_mc_jit is None:
-            def body(data_l, nvalid_l, mask_, shr, b):
-                return self._train_iter(data_l, mask_, shr, b,
-                                        n_valid=nvalid_l[0])
+            if quant:
+                def body(data_l, nvalid_l, mask_, shr, b, key):
+                    return self._train_iter(data_l, mask_, shr, b,
+                                            n_valid=nvalid_l[0], key=key)
+                in_specs = (P(None, "data"), P("data"), P(), P(), P(), P())
+            else:
+                def body(data_l, nvalid_l, mask_, shr, b):
+                    return self._train_iter(data_l, mask_, shr, b,
+                                            n_valid=nvalid_l[0])
+                in_specs = (P(None, "data"), P("data"), P(), P(), P())
             f = functools.partial(
                 shard_map, mesh=self.mesh, check_vma=False,
-                in_specs=(P(None, "data"), P("data"), P(), P(), P()),
+                in_specs=in_specs,
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
             self._iter_mc_jit = get_manager().jit_entry(
                 "mc/train_iter", jax.jit(f, donate_argnums=0))
+        args = (data, self._n_per_shard, mask, jnp.float32(shrinkage),
+                jnp.float32(bias))
+        if quant:
+            args = args + (self._next_quant_keys(1)[0],)
         with collective_span("fused_iter_psum", self._tree_psum_bytes):
-            return self._iter_mc_jit(data, self._n_per_shard, mask,
-                                     jnp.float32(shrinkage),
-                                     jnp.float32(bias))
+            return self._iter_mc_jit(*args)
 
     def train_iters_persistent(self, data, shrinkage, masks):
         """K sharded iterations in one dispatch (scan inside shard_map);
         see FusedSerialGrower.train_iters_persistent."""
         k = int(masks.shape[0])
+        quant = self._quant
         if getattr(self, "_iters_mc_jit_k", None) is None:
             self._iters_mc_jit_k = {}
         if k not in self._iters_mc_jit_k:
-            def body(data_l, nvalid_l, masks_, shr):
-                def step(d, mask):
-                    d, ta = self._train_iter(d, mask, shr, jnp.float32(0.0),
-                                             n_valid=nvalid_l[0])
-                    return d, ta
-                return jax.lax.scan(step, data_l, masks_, length=k)
+            if quant:
+                def body(data_l, nvalid_l, masks_, shr, keys):
+                    def step(d, xs):
+                        mask, key = xs
+                        d, ta = self._train_iter(d, mask, shr,
+                                                 jnp.float32(0.0),
+                                                 n_valid=nvalid_l[0],
+                                                 key=key)
+                        return d, ta
+                    return jax.lax.scan(step, data_l, (masks_, keys),
+                                        length=k)
+                in_specs = (P(None, "data"), P("data"), P(), P(), P())
+            else:
+                def body(data_l, nvalid_l, masks_, shr):
+                    def step(d, mask):
+                        d, ta = self._train_iter(d, mask, shr,
+                                                 jnp.float32(0.0),
+                                                 n_valid=nvalid_l[0])
+                        return d, ta
+                    return jax.lax.scan(step, data_l, masks_, length=k)
+                in_specs = (P(None, "data"), P("data"), P(), P())
             f = functools.partial(
                 shard_map, mesh=self.mesh, check_vma=False,
-                in_specs=(P(None, "data"), P("data"), P(), P()),
+                in_specs=in_specs,
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
             self._iters_mc_jit_k[k] = get_manager().jit_entry(
                 f"mc/train_iters_k{k}", jax.jit(f, donate_argnums=0))
+        args = (data, self._n_per_shard, masks, jnp.float32(shrinkage))
+        if quant:
+            args = args + (self._next_quant_keys(k),)
         with collective_span("fused_iter_psum", k * self._tree_psum_bytes):
-            return self._iters_mc_jit_k[k](data, self._n_per_shard, masks,
-                                           jnp.float32(shrinkage))
+            return self._iters_mc_jit_k[k](*args)
 
     def _sync_scores(self, data):
         from ..ops import plane

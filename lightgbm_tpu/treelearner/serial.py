@@ -32,6 +32,7 @@ from ..io.dataset import BinnedDataset
 from ..io.binning import BIN_CATEGORICAL
 from ..models.tree import Tree
 from ..ops import histogram as H
+from ..ops import quantize as Q
 from ..ops import split as S
 from ..obs import instrument_kernel
 from ..ops.partition import next_capacity, partition_leaf
@@ -151,11 +152,20 @@ class SerialTreeGrower:
         self._cur_perm = None
         self._cur_grad = None
         self._cur_hess = None
+        # quantized-gradient training (ops/quantize.py): per-tree scales
+        # of the current iteration, None on the f32 path
+        self._quant = bool(config.use_quantized_grad)
+        self._qscales = None
+        self._quant_tree_idx = 0
 
     # ------------------------------------------------------------------
     def _split_packed(self, hist, sum_g, sum_h, num_data, parent_output,
                       cmin, cmax, feature_mask, rand_thresholds,
-                      cegb_delta=None, gain_scale=None):
+                      cegb_delta=None, gain_scale=None, qscales=None):
+        if qscales is not None:
+            # integer level-sums meet float arithmetic here and only
+            # here (sum_g/sum_h are already dequantized leaf totals)
+            hist = S.dequantize_hist(hist, qscales[0], qscales[1])
         res = S.best_split(hist, self.meta, self.split_cfg, sum_g, sum_h,
                            num_data, parent_output, cmin, cmax,
                            feature_mask=feature_mask,
@@ -309,6 +319,21 @@ class SerialTreeGrower:
                 cfg.monotone_constraints_method, cfg.num_leaves,
                 self._monotone_np)
 
+        raw_grad, raw_hess = grad, hess
+        self._qscales = None
+        if self._quant:
+            # one quantization pass per tree; histograms, the pool, and
+            # subtraction then run in exact int32 level space
+            Q.note_requantize(cfg.num_grad_quant_bins)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
+                self._quant_tree_idx)
+            self._quant_tree_idx += 1
+            grad, hess, gs, hs = Q.quantize_gradients(
+                grad, hess, cfg.num_grad_quant_bins, key,
+                cfg.stochastic_rounding)
+            self._qscales = (gs, hs)
+
         self._cur_perm, self._cur_grad, self._cur_hess = perm, grad, hess
         root = _Leaf(0, num_data, 0.0, 0.0, 0.0, 0)
         cap = next_capacity(num_data)
@@ -316,8 +341,17 @@ class SerialTreeGrower:
         # root sums from the histogram (every row lands in exactly one bin
         # of feature 0), so out-of-bag rows never contribute — the
         # reference computes these in LeafSplits::Init over bag indices
-        root.sum_g = float(jnp.sum(root.hist[0, :, 0]))
-        root.sum_h = float(jnp.sum(root.hist[0, :, 1]))
+        if self._quant:
+            # leaf totals live in dequantized f32 units host-side
+            self._qscales_host = (float(self._qscales[0]),
+                                  float(self._qscales[1]))
+            root.sum_g = float(jnp.sum(root.hist[0, :, 0])) \
+                * self._qscales_host[0]
+            root.sum_h = float(jnp.sum(root.hist[0, :, 1])) \
+                * self._qscales_host[1]
+        else:
+            root.sum_g = float(jnp.sum(root.hist[0, :, 0]))
+            root.sum_h = float(jnp.sum(root.hist[0, :, 1]))
         leaves: Dict[int, _Leaf] = {0: root}
         if self._forced_splits is not None:
             perm = self._apply_forced_splits(tree, leaves, perm, grad, hess)
@@ -344,7 +378,44 @@ class SerialTreeGrower:
                                     tree_mask, rand_thr)
 
         self.last_perm = perm
+        if self._quant and cfg.quant_train_renew_leaf:
+            self._renew_leaf_values(tree, leaves, perm, raw_grad, raw_hess)
         return tree
+
+    def _renew_leaf_values(self, tree: Tree, leaves: Dict[int, _Leaf],
+                           perm, grad, hess) -> None:
+        """Refit leaf outputs from the EXACT f32 grad/hess sums after a
+        quantized growth (reference quant_train_renew_leaf,
+        gradient_discretizer RenewIntGradTreeOutput): the tree structure
+        keeps the quantized decisions, the leaf values drop the
+        level-rounding error. Window sums come from one device cumsum
+        over the final leaf-ordered permutation; only per-leaf boundary
+        prefix values transfer to the host."""
+        items = [(lid, lf) for lid, lf in leaves.items() if lf.count > 0]
+        if not items:
+            return
+        cg = jnp.cumsum(grad[perm])
+        ch = jnp.cumsum(hess[perm])
+        ends = jnp.asarray([lf.start + lf.count - 1 for _, lf in items],
+                           jnp.int32)
+        los = np.asarray([lf.start - 1 for _, lf in items])
+        lo_idx = jnp.asarray(np.maximum(los, 0), jnp.int32)
+        ge, he, gl, hl = jax.device_get(
+            (cg[ends], ch[ends], cg[lo_idx], ch[lo_idx]))
+        has_lo = los >= 0
+        sum_g = np.asarray(ge, np.float64) - np.where(has_lo, gl, 0.0)
+        sum_h = np.asarray(he, np.float64) - np.where(has_lo, hl, 0.0)
+        cfg = self.config
+        for (lid, lf), g, h in zip(items, sum_g, sum_h):
+            if cfg.lambda_l1 > 0:
+                g = np.sign(g) * max(abs(g) - cfg.lambda_l1, 0.0)
+            out = -g / (h + cfg.lambda_l2 + S.K_EPSILON)
+            if cfg.max_delta_step > 0:
+                out = float(np.clip(out, -cfg.max_delta_step,
+                                    cfg.max_delta_step))
+            if self.use_monotone:
+                out = float(np.clip(out, lf.cmin, lf.cmax))
+            tree.leaf_value[lid] = float(out)
 
     # ------------------------------------------------------------------
     def _compute_best(self, leaf: _Leaf, tree_mask: np.ndarray,
@@ -372,12 +443,16 @@ class SerialTreeGrower:
                                           self.config.monotone_penalty)
             scale = jnp.asarray(
                 np.where(self._monotone_np != 0, fac, 1.0), jnp.float32)
-        vec, ivec, cat = self._split_jit(
+        args = (
             leaf.hist, jnp.float32(leaf.sum_g), jnp.float32(leaf.sum_h),
             jnp.int32(leaf.count), jnp.float32(leaf.output),
             jnp.float32(leaf.cmin), jnp.float32(leaf.cmax),
             jnp.asarray(mask), rand_thr if rand_thr is not None
             else jnp.zeros(self.num_features, jnp.int32), cegb, scale)
+        if self._qscales is not None:
+            vec, ivec, cat = self._split_jit(*args, self._qscales)
+        else:
+            vec, ivec, cat = self._split_jit(*args)
         v = np.asarray(vec, dtype=np.float64)
         iv = np.asarray(ivec, dtype=np.int64)
         if drop_after:
@@ -549,6 +624,9 @@ class SerialTreeGrower:
                     self.bins, perm, jnp.int32(leaf.start),
                     jnp.int32(leaf.count), grad, hess)
             hist = np.asarray(leaf.hist[inner], dtype=np.float64)  # [B, 2]
+            if self._quant:
+                # level-sums → f32 units to match leaf.sum_g/sum_h
+                hist = hist * np.asarray(self._qscales_host, np.float64)
             miss = int(self.feature_miss_bin[inner])
             sel = np.arange(hist.shape[0]) <= thr_bin
             if miss >= 0:

@@ -7,7 +7,10 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   `--metrics-out` (one record per line, `obs.sink.validate_record`;
   schema v1.1 records additionally carry `schema_minor` plus the AOT
   compile-manager `compile.*`/`eval.*` counters and
-  compile/aot_load/aot_serialize phase timers),
+  compile/aot_load/aot_serialize phase timers; v1.2 adds the
+  quantized-gradient `hist.quant_*` counters — requantize passes,
+  packed collective bytes, overflow escalations — and the
+  `hist.quant_bins` gauge),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py
