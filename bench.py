@@ -79,7 +79,8 @@ QUANT_BINS = int(os.environ.get("BENCH_QUANT_BINS", 64))
 STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "iters_done": 0, "iter_times": [], "test_auc": None,
          "example_auc": None, "predict_us_per_row": None,
-         "example_auc_reference": None, "hist_method": None}
+         "example_auc_reference": None, "hist_method": None,
+         "hot_loop_syncs": None}
 # obs.MetricsRegistry activated in main() once lightgbm_tpu is imported;
 # emit() appends its per-phase breakdown AFTER the pre-existing keys so
 # the line stays byte-compatible on everything consumers already parse
@@ -168,6 +169,11 @@ def emit(partial: bool) -> None:
         if core > 0:
             out["hist_share"] = round(
                 REGISTRY.times.get("hist", 0.0) / core, 4)
+    # static hot-loop sync inventory (schema minor 3), precomputed in
+    # main() — emit() can run from the alarm handler, where re-walking
+    # the package AST would blow the signal budget
+    if STATE["hot_loop_syncs"] is not None:
+        out["hot_loop_syncs"] = STATE["hot_loop_syncs"]
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -295,6 +301,18 @@ def main():
     global REGISTRY
     REGISTRY = lgb.obs.MetricsRegistry()
     lgb.obs.activate(REGISTRY)
+
+    # static hot-loop sync inventory, computed up-front so emit() can
+    # report it even when fired from the alarm handler
+    try:
+        from lightgbm_tpu.analysis import sync_points
+        from lightgbm_tpu.analysis.core import Package
+        pkg_root = os.path.dirname(os.path.abspath(__file__))
+        STATE["hot_loop_syncs"] = sync_points.hot_sync_count(
+            Package.load(pkg_root))
+    except Exception as exc:
+        print(f"# tpulint sync inventory unavailable: {exc}",
+              file=sys.stderr)
 
     # ONE draw of the generating function; the last TEST_ROWS are held
     # out (a different seed would draw different weights — a different

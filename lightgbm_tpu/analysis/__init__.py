@@ -1,0 +1,126 @@
+"""tpulint — project-specific static analysis for lightgbm_tpu.
+
+Four rule packs over a plain-`ast` model of the package (core.py):
+
+- trace-safety      implicit tracer concretization inside jitted code
+- sync-point        un-annotated host syncs on the training hot path
+- recompile-hazard  jit sites dodging the compile manager, entry
+                    signature drift, config fields missing from the
+                    AOT signature
+- lock-discipline   attributes mutated both under and outside a class's
+                    `with self._lock`
+
+Run `python -m lightgbm_tpu.analysis` (exit 0 = clean against the
+checked-in baseline), or call `run()` programmatically. The rule
+catalogue, pragma syntax, and baseline workflow are documented in
+docs/STATIC_ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from .core import (  # noqa: F401  (re-exported API)
+    Finding,
+    Package,
+    PRAGMA_KINDS,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from . import locks, recompile, sync_points, trace_safety
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+RULE_PACKS = {
+    "trace-safety": trace_safety.check,
+    "sync-point": sync_points.check,
+    "recompile-hazard": recompile.check,
+    "lock-discipline": locks.check,
+}
+
+
+def pragma_hygiene(pkg: Package) -> List[Finding]:
+    """Malformed pragmas are findings themselves: unknown kind, or a
+    suppression with no reason."""
+    out: List[Finding] = []
+    for rel in sorted(pkg.files):
+        sf = pkg.files[rel]
+        for line in sorted(sf.pragmas):
+            for p in sf.pragmas[line]:
+                if p.kind not in PRAGMA_KINDS:
+                    out.append(Finding(
+                        "pragma", rel, line, "", f"unknown-kind:{p.kind}",
+                        f"unknown tpulint pragma kind '{p.kind}' (valid: "
+                        f"{', '.join(PRAGMA_KINDS)})"))
+                elif not p.reason:
+                    out.append(Finding(
+                        "pragma", rel, line, "", f"missing-reason:{p.kind}",
+                        f"tpulint pragma '{p.kind}' needs a reason: "
+                        f"# tpulint: {p.kind}(<why this is deliberate>)"))
+    return out
+
+
+def collect(pkg: Package,
+            rules: Optional[List[str]] = None) -> List[Finding]:
+    """All findings from the selected rule packs (default: all four
+    plus pragma hygiene), in (path, line) order."""
+    findings: List[Finding] = []
+    for name, fn in RULE_PACKS.items():
+        if rules is None or name in rules:
+            findings.extend(fn(pkg))
+    if rules is None or "pragma" in (rules or []):
+        findings.extend(pragma_hygiene(pkg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.code))
+    return findings
+
+
+@dataclasses.dataclass
+class RunResult:
+    new: List[Finding]          # findings NOT absorbed by the baseline
+    baselined: List[Finding]    # findings the baseline absorbed
+    baseline_size: int          # total allowed occurrences in the baseline
+    hot_sync_count: int         # classified hot-loop sync sites (incl.
+    #                             annotated ones) — bench.py's metric
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def run(root: Optional[str] = None,
+        baseline_path: Optional[str] = None,
+        rules: Optional[List[str]] = None,
+        pkg: Optional[Package] = None) -> RunResult:
+    """Analyze the package and apply the baseline.
+
+    Publishes `lint.findings` / `lint.baseline_size` gauges to the
+    active obs registry (schema minor 3) when one is installed.
+    """
+    if pkg is None:
+        pkg = Package.load(root)
+    findings = collect(pkg, rules)
+    if baseline_path is None:
+        baseline_path = DEFAULT_BASELINE
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    new, baselined = apply_baseline(findings, baseline)
+    result = RunResult(new, baselined, sum(baseline.values()),
+                       sync_points.hot_sync_count(pkg))
+    try:  # obs is optional here: the linter must run without jax
+        from .. import obs
+        reg = obs.active()
+        if reg is not None:
+            reg.set_gauge("lint.findings", float(len(findings)))
+            reg.set_gauge("lint.baseline_size", float(result.baseline_size))
+    except Exception:
+        pass
+    return result
+
+
+def summary(result: RunResult) -> Dict[str, int]:
+    by_rule: Dict[str, int] = {}
+    for f in result.new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return by_rule

@@ -1,0 +1,83 @@
+"""CLI: `python -m lightgbm_tpu.analysis`.
+
+Exit status 0 iff every finding is absorbed by the baseline. Typical
+use:
+
+    python -m lightgbm_tpu.analysis                 # lint the repo
+    python -m lightgbm_tpu.analysis --format json   # machine-readable
+    python -m lightgbm_tpu.analysis --rules sync-point,lock-discipline
+    python -m lightgbm_tpu.analysis --write-baseline  # re-audit ONLY:
+        # regenerates baseline.json from current findings. The baseline
+        # workflow is shrink-only — see docs/STATIC_ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import (DEFAULT_BASELINE, Package, RULE_PACKS, collect, run,
+               save_baseline, summary)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="tpulint: JAX-aware static analysis for lightgbm_tpu")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(re-audit only; the baseline never grows in "
+                         "normal workflow)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset: "
+                         + ",".join(RULE_PACKS) + ",pragma")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    if args.write_baseline:
+        pkg = Package.load(args.root)
+        findings = collect(pkg, rules)
+        entries = save_baseline(args.baseline, findings)
+        print(f"wrote {args.baseline}: {sum(entries.values())} occurrences "
+              f"across {len(entries)} keys")
+        return 0
+
+    result = run(args.root, "" if args.no_baseline else args.baseline,
+                 rules)
+    if args.no_baseline:
+        result.new.extend(result.baselined)
+        result.baselined = []
+        result.new.sort(key=lambda f: (f.path, f.line, f.rule, f.code))
+
+    if args.format == "json":
+        print(json.dumps({
+            "ok": result.ok,
+            "new": [vars(f) for f in result.new],
+            "baselined": len(result.baselined),
+            "baseline_size": result.baseline_size,
+            "hot_sync_count": result.hot_sync_count,
+        }, indent=1))
+    else:
+        for f in result.new:
+            print(str(f))
+        by_rule = summary(result)
+        tail = ("  [" + ", ".join(f"{k}: {v}" for k, v in
+                                  sorted(by_rule.items())) + "]"
+                if by_rule else "")
+        print(f"tpulint: {len(result.new)} new finding(s){tail}, "
+              f"{len(result.baselined)} baselined "
+              f"(baseline budget {result.baseline_size}), "
+              f"{result.hot_sync_count} hot-loop sync site(s)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

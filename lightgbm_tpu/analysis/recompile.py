@@ -1,0 +1,302 @@
+"""Rule pack: recompile-hazard.
+
+Three sub-rules protecting the AOT compile cache (PR 2):
+
+1. **jit-unmanaged** — every `jax.jit` site outside `compile/` must
+   route through the compile manager (`get_manager().jit_entry(...)` /
+   `shared_entry(...)`) or carry `# tpulint: jit-ok(<reason>)`. Ad-hoc
+   jits dodge the recompile counters and the zero-recompile acceptance
+   check, which is how signature drift goes unnoticed.
+2. **entry-signature** — all registrations of one entry NAME must wrap
+   callables with the same positional arity and the same
+   static_argnums/static_argnames. Two learners registering
+   "serial/split_scan" with different arity would alias distinct traced
+   programs under one store key.
+3. **config-field** — a Config field read inside traced code must be
+   part of the AOT compile signature: reading a field listed in
+   `signature.py:_IGNORED_CONFIG_FIELDS` from a traced function means
+   two configs differing only in that field replay the SAME serialized
+   executable. Also flags stale `_IGNORED_CONFIG_FIELDS` entries that no
+   longer name a Config dataclass field.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Package, dotted
+from .trace_safety import _JitRoots, traced_functions
+
+_SIGNATURE_REL = "lightgbm_tpu/compile/signature.py"
+_CONFIG_REL = "lightgbm_tpu/config.py"
+_MANAGED_DIR = "lightgbm_tpu/compile/"
+_REGISTER_METHODS = ("jit_entry", "shared_entry")
+_CONFIG_BASES = ("cfg", "config")
+
+
+def _jit_call_sites(pkg: Package, rel: str) -> List[ast.Call]:
+    """All `jax.jit(...)` / `<alias>.jit(...)` Call nodes in `rel`."""
+    imps = pkg.imports[rel]
+    out = []
+    for node in ast.walk(pkg.files[rel].tree):
+        if isinstance(node, ast.Call):
+            fd = dotted(node.func)
+            if fd is None:
+                continue
+            parts = fd.split(".")
+            if parts[-1] == "jit" and len(parts) > 1 \
+                    and parts[0] in imps.jax:
+                out.append(node)
+    return out
+
+
+def _decorator_jits(pkg: Package, rel: str) -> List[Tuple[ast.AST, ast.AST]]:
+    """(function node, decorator node) for @jax.jit /
+    @functools.partial(jax.jit, ...) decorators in `rel`."""
+    imps = pkg.imports[rel]
+
+    def is_jit(node: ast.AST) -> bool:
+        fd = dotted(node)
+        return fd is not None and fd.split(".")[-1] == "jit" \
+            and fd.split(".")[0] in imps.jax
+
+    out = []
+    for fi in pkg.functions.values():
+        if fi.rel != rel:
+            continue
+        for dec in getattr(fi.node, "decorator_list", []):
+            if is_jit(dec):
+                out.append((fi.node, dec))
+            elif isinstance(dec, ast.Call):
+                if is_jit(dec.func):
+                    out.append((fi.node, dec))
+                else:
+                    fd = dotted(dec.func)
+                    if fd is not None and fd.split(".")[-1] == "partial" \
+                            and dec.args and is_jit(dec.args[0]):
+                        out.append((fi.node, dec))
+    return out
+
+
+def _registration_args(pkg: Package, rel: str
+                       ) -> List[Tuple[str, ast.Call, ast.AST]]:
+    """(entry name, registration call, wrapped expr) for every
+    `*.jit_entry("name", expr)` / `*.shared_entry("name", sig, build)`."""
+    out = []
+    for node in ast.walk(pkg.files[rel].tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTER_METHODS and node.args):
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name: Optional[str] = first.value
+        elif isinstance(first, ast.JoinedStr):
+            name = None          # dynamic entry name (f-string)
+        else:
+            continue
+        wrapped = node.args[1] if node.func.attr == "jit_entry" \
+            and len(node.args) > 1 else None
+        out.append((name, node, wrapped))
+    return out
+
+
+def _routed_names(pkg: Package, rel: str) -> set:
+    """Local names handed to a jit_entry()/shared_entry() registration
+    anywhere in `rel`. A jit bound to such a name IS manager-routed —
+    the builder pattern registers it one statement later."""
+    names = set()
+    for _name, reg, _w in _registration_args(pkg, rel):
+        for arg in reg.args[1:]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _inside_registration(pkg: Package, rel: str, jit_call: ast.Call) -> bool:
+    """True when the jit call node is an argument of a jit_entry()
+    registration (i.e. routed through the manager)."""
+    for _name, reg, _w in _registration_args(pkg, rel):
+        for arg in reg.args:
+            for sub in ast.walk(arg):
+                if sub is jit_call:
+                    return True
+    return False
+
+
+def _jit_statics(call: ast.Call) -> Tuple:
+    """Canonical (static_argnums, static_argnames) of one jit call."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.append(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.append(n.value)
+    return (tuple(sorted(nums)), tuple(sorted(names)))
+
+
+def _wrapped_arity(pkg: Package, rel: str, caller, expr: ast.AST
+                   ) -> Optional[Tuple[int, Tuple]]:
+    """(positional arity, statics) of the callable a registration wraps,
+    unwrapping one jax.jit(...) layer. None when unresolvable."""
+    statics: Tuple = ((), ())
+    target = expr
+    if isinstance(expr, ast.Call):
+        fd = dotted(expr.func)
+        if fd is not None and fd.split(".")[-1] == "jit" and expr.args:
+            statics = _jit_statics(expr)
+            target = expr.args[0]
+        else:
+            return None
+    for q in pkg.resolve_call(rel, caller, target):
+        fi = pkg.functions.get(q)
+        if fi is not None:
+            params = [p for p in fi.params if p not in ("self", "cls")]
+            return (len(params), statics)
+    return None
+
+
+def _config_fields(pkg: Package) -> Set[str]:
+    sf = pkg.files.get(_CONFIG_REL)
+    if sf is None:
+        return set()
+    fields: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    fields.add(stmt.target.id)
+    return fields
+
+
+def _ignored_fields(pkg: Package) -> Tuple[Set[str], int]:
+    """(field set, lineno) of `_IGNORED_CONFIG_FIELDS` in signature.py."""
+    sf = pkg.files.get(_SIGNATURE_REL)
+    if sf is None:
+        return set(), 0
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and t.id == "_IGNORED_CONFIG_FIELDS":
+                    vals = {n.value for n in ast.walk(node.value)
+                            if isinstance(n, ast.Constant)
+                            and isinstance(n.value, str)}
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_config_read(node: ast.Attribute) -> bool:
+    """`cfg.<f>` / `config.<f>` / `self.config.<f>` / `self.cfg.<f>`."""
+    base = node.value
+    if isinstance(base, ast.Name) and base.id in _CONFIG_BASES:
+        return True
+    if isinstance(base, ast.Attribute) and base.attr in _CONFIG_BASES \
+            and isinstance(base.value, ast.Name) \
+            and base.value.id == "self":
+        return True
+    return False
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # (1) unmanaged jax.jit sites
+    for rel in sorted(pkg.files):
+        if rel.startswith(_MANAGED_DIR):
+            continue
+        sf = pkg.files[rel]
+        routed = _routed_names(pkg, rel)
+        for fnode, dec in _decorator_jits(pkg, rel):
+            if sf.pragma_at(dec.lineno, "jit-ok") \
+                    or sf.pragma_at(fnode.lineno, "jit-ok"):
+                continue
+            if getattr(fnode, "name", None) in routed:
+                continue         # builder pattern: registered below
+            fi = pkg.enclosing_function(rel, fnode)
+            findings.append(Finding(
+                "recompile-hazard", rel, dec.lineno,
+                fi.qual if fi is not None else "", "jit-unmanaged",
+                "@jax.jit decorator bypasses the compile manager; register "
+                "via jit_entry()/shared_entry() or annotate "
+                "`# tpulint: jit-ok(<reason>)`"))
+        bound_to: Dict[int, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        for sub in ast.walk(node.value):
+                            bound_to[id(sub)] = t.id
+        for call in _jit_call_sites(pkg, rel):
+            if sf.pragma_at(call.lineno, "jit-ok"):
+                continue
+            if _inside_registration(pkg, rel, call):
+                continue
+            if bound_to.get(id(call)) in routed:
+                continue         # `x = jax.jit(...)` then jit_entry(.., x)
+            fi = pkg.enclosing_function(rel, call)
+            findings.append(Finding(
+                "recompile-hazard", rel, call.lineno,
+                fi.qual if fi is not None else "", "jit-unmanaged",
+                "jax.jit() call bypasses the compile manager; register via "
+                "jit_entry()/shared_entry() or annotate "
+                "`# tpulint: jit-ok(<reason>)`"))
+
+    # (2) per-name registration signature consistency
+    seen: Dict[str, Tuple[Tuple[int, Tuple], str, int]] = {}
+    for rel in sorted(pkg.files):
+        for name, reg, wrapped in _registration_args(pkg, rel):
+            if wrapped is None or name is None:
+                continue
+            caller = pkg.enclosing_function(rel, reg)
+            sig = _wrapped_arity(pkg, rel, caller, wrapped)
+            if sig is None:
+                continue
+            prev = seen.get(name)
+            if prev is None:
+                seen[name] = (sig, rel, reg.lineno)
+            elif prev[0] != sig:
+                fi = pkg.enclosing_function(rel, reg)
+                findings.append(Finding(
+                    "recompile-hazard", rel, reg.lineno,
+                    fi.qual if fi is not None else "",
+                    f"entry-signature:{name}",
+                    f"entry '{name}' registered with arity/statics {sig} "
+                    f"but {prev[1]}:{prev[2]} registered {prev[0]}; one "
+                    "store key would alias two traced programs"))
+
+    # (3) ignored-config fields read inside traced code
+    cfg_fields = _config_fields(pkg)
+    ignored, ignored_line = _ignored_fields(pkg)
+    for stale in sorted(ignored - cfg_fields):
+        findings.append(Finding(
+            "recompile-hazard", _SIGNATURE_REL, ignored_line, "",
+            f"stale-ignored:{stale}",
+            f"_IGNORED_CONFIG_FIELDS entry '{stale}' is not a Config "
+            "field; remove it"))
+    traced = set(traced_functions(pkg))
+    traced |= set(_JitRoots(pkg).roots)
+    for qual in sorted(traced):
+        fi = pkg.functions.get(qual)
+        if fi is None:
+            continue
+        sf = pkg.files[fi.rel]
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Attribute) and _is_config_read(node) \
+                    and node.attr in ignored and node.attr in cfg_fields:
+                if sf.pragma_at(node.lineno, "jit-ok"):
+                    continue
+                findings.append(Finding(
+                    "recompile-hazard", fi.rel, node.lineno, qual,
+                    f"config-field:{node.attr}",
+                    f"Config.{node.attr} is read inside traced code but "
+                    "listed in _IGNORED_CONFIG_FIELDS — two configs "
+                    "differing only here would share one executable"))
+    return findings

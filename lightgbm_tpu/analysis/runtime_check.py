@@ -1,0 +1,87 @@
+"""Runtime cross-check for the static sync-point classification.
+
+Two complementary probes, used by the slow test in
+tests/test_tpulint.py (and importable for ad-hoc debugging):
+
+- `record_device_gets()` — monkeypatches `jax.device_get` for the
+  duration of the context and records the innermost *package* source
+  location of every call. Comparing the recorded `(rel, line)` set
+  against `static_hot_inventory()` validates that the linter's
+  call-graph classification actually covers what runs per iteration.
+  (Implicit `np.asarray`/`__array__` transfers can't be patched on
+  pybind array types, so the recorder covers the explicit channel; the
+  transfer guard below covers the implicit one.)
+- `transfer_guard_no_transfers()` — `jax.transfer_guard_device_to_host
+  ("disallow")`: any device->host transfer inside the context raises,
+  proving a code region is sync-free (or demonstrating a known sync
+  site fires, for the positive control).
+
+jax is imported lazily inside the helpers: the linter core must stay
+importable (and fast) without touching jax at all.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import traceback
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Package
+from . import sync_points
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def package_site(skip_analysis: bool = True) -> Optional[Tuple[str, int]]:
+    """(repo-relative path, line) of the innermost stack frame inside
+    the package, skipping this analysis subpackage itself."""
+    here = os.path.join(_PKG_DIR, "analysis") + os.sep
+    for frame in reversed(traceback.extract_stack()):
+        fn = os.path.abspath(frame.filename)
+        if not fn.startswith(_PKG_DIR + os.sep):
+            continue
+        if skip_analysis and fn.startswith(here):
+            continue
+        # keys match Package rels: repo-root-relative, e.g.
+        # "lightgbm_tpu/boosting/gbdt.py"
+        rel = os.path.relpath(fn, os.path.dirname(_PKG_DIR))
+        return rel, frame.lineno
+    return None
+
+
+@contextlib.contextmanager
+def record_device_gets(sites: List[Tuple[str, int]]) -> Iterator[None]:
+    """Patch jax.device_get to append each caller's package (rel, line)
+    to `sites` (duplicates kept: the count matters for budget checks)."""
+    import jax
+
+    real = jax.device_get
+
+    def recording_device_get(*args, **kwargs):
+        site = package_site()
+        if site is not None:
+            sites.append(site)
+        return real(*args, **kwargs)
+
+    jax.device_get = recording_device_get
+    try:
+        yield
+    finally:
+        jax.device_get = real
+
+
+@contextlib.contextmanager
+def transfer_guard_no_transfers() -> Iterator[None]:
+    """Raise on ANY device->host transfer inside the context."""
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+def static_hot_inventory(pkg: Optional[Package] = None
+                         ) -> Dict[str, Set[int]]:
+    """rel -> hot sync-site lines per the static classification."""
+    if pkg is None:
+        pkg = Package.load()
+    return sync_points.hot_site_lines(pkg)

@@ -1,0 +1,244 @@
+"""Rule pack: sync-point budget.
+
+Builds an inventory of every host<->device synchronization site in the
+package — explicit (`jax.device_get`, `.block_until_ready()`) and
+implicit (`.item()` / `.tolist()`, `np.asarray`/`np.array` on a device
+value, `float()`/`int()`/`bool()` on a device value) — and classifies
+each as **hot-loop** (reachable from the per-iteration training roots)
+or **setup**.
+
+Hot roots: `GBDT.train_one_iter` / `GBDT.eval_at_iter` (plus subclass
+overrides) and `engine._telemetry_end_iteration`. Reachability uses the
+package call graph, whose unknown-receiver fallback deliberately
+over-approximates: a sync wrongly marked hot costs one pragma, one
+wrongly marked setup is a silent per-iteration regression.
+
+"Device value" is a local, per-function heuristic: results of
+`jnp.*` / `jax.*` calls, of calls through a `*_jit`/`*_fn` attribute
+(the manager-registered entries follow that naming), subscripts /
+attributes thereof, and names assigned from any of those.
+
+Only HOT sites lacking a `# tpulint: sync-ok(<reason>)` pragma become
+findings; the checked-in baseline absorbs the audited pre-existing
+inventory. New hot syncs therefore fail CI until annotated or batched.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Package, Pragma, dotted
+
+# qual suffixes of the per-iteration hot roots
+_HOT_ROOT_SUFFIXES = (".train_one_iter", ".eval_at_iter")
+_HOT_ROOT_FILES = ("lightgbm_tpu/boosting/", "lightgbm_tpu/engine.py")
+_HOT_ROOT_EXACT = ("lightgbm_tpu/engine.py::_telemetry_end_iteration",)
+
+# attribute-call names treated as producing device arrays
+_DEVICE_FN_SUFFIXES = ("_jit", "_fn")
+
+
+@dataclasses.dataclass
+class SyncSite:
+    rel: str
+    line: int
+    func: str          # enclosing function qual ("" at module level)
+    code: str          # stable site descriptor ("device_get", ".item()", ...)
+    hot: bool
+    pragma: Optional[Pragma]
+
+    @property
+    def annotated(self) -> bool:
+        return self.pragma is not None
+
+
+def hot_roots(pkg: Package) -> List[str]:
+    roots = [q for q in _HOT_ROOT_EXACT if q in pkg.functions]
+    for q in pkg.functions:
+        if q.startswith(_HOT_ROOT_FILES) and q.endswith(_HOT_ROOT_SUFFIXES):
+            roots.append(q)
+    return sorted(set(roots))
+
+
+class _DeviceTaint(ast.NodeVisitor):
+    """Names bound to likely-device values inside one function body."""
+
+    def __init__(self, pkg: Package, rel: str) -> None:
+        self.imps = pkg.imports[rel]
+        self.devicey: Set[str] = set()
+
+    def is_devicey(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.devicey
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self.is_devicey(node.value)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "ndim", "dtype", "size"):
+                return False
+            d = dotted(node)
+            if d is not None and d in self.devicey:
+                return True
+            return self.is_devicey(node.value)
+        if isinstance(node, ast.Call):
+            fd = dotted(node.func)
+            if fd is not None:
+                root, leaf = fd.split(".")[0], fd.split(".")[-1]
+                if leaf == "device_get":
+                    return False    # the sync itself: result is host data
+                if root in self.imps.numpy:
+                    return False    # np.* results live on the host
+                if root in (self.imps.jnp | self.imps.jax):
+                    return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr.endswith(_DEVICE_FN_SUFFIXES):
+                return True
+            return any(self.is_devicey(a) for a in node.args)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_devicey(node.left) or self.is_devicey(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_devicey(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_devicey(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_devicey(node.body) or self.is_devicey(node.orelse)
+        return False
+
+    def _bind(self, target: ast.AST, devicey: bool) -> None:
+        # bind whole targets only ("x", "leaf.hist"), never the names
+        # INSIDE a target — `self.a, b = dev, dev` must not taint `self`.
+        # A host-valued rebind KILLS the taint: after
+        # `x, y = jax.device_get((x, y))` the names hold host data.
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, devicey)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind(target.value, devicey)
+            return
+        if isinstance(target, ast.Subscript):
+            if devicey:
+                self._bind(target.value, devicey)
+            return
+        d = dotted(target)
+        if d is not None:
+            if devicey:
+                self.devicey.add(d)
+            else:
+                self.devicey.discard(d)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        dev = self.is_devicey(node.value)
+        for t in node.targets:
+            self._bind(t, dev)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind(node.target, self.is_devicey(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self.is_devicey(node.value):
+            self._bind(node.target, True)
+
+    def visit_FunctionDef(self, node):  # nested: separate scope
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+
+def _sites_in_function(pkg: Package, qual: str, hot: bool) -> List[SyncSite]:
+    fi = pkg.functions[qual]
+    sf = pkg.files[fi.rel]
+    imps = pkg.imports[fi.rel]
+    taint = _DeviceTaint(pkg, fi.rel)
+    body = getattr(fi.node, "body", [])
+    # two passes: bind device names first (source order suffices for the
+    # package's straight-line hot loops), then collect sites
+    for stmt in body:
+        taint.visit(stmt)
+    out: List[SyncSite] = []
+
+    def add(node: ast.AST, code: str) -> None:
+        out.append(SyncSite(fi.rel, node.lineno, qual, code, hot,
+                            sf.pragma_at(node.lineno, "sync-ok")))
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            self.generic_visit(node)
+            fd = dotted(node.func)
+            if fd is not None:
+                parts = fd.split(".")
+                root, leaf = parts[0], parts[-1]
+                if leaf == "device_get" and (root in imps.jax
+                                             or len(parts) == 1):
+                    add(node, "device_get")
+                    return
+                if root in imps.numpy and leaf in ("asarray", "array") \
+                        and node.args and taint.is_devicey(node.args[0]):
+                    add(node, f"np.{leaf}")
+                    return
+                if len(parts) == 1 and leaf in ("float", "int", "bool") \
+                        and node.args and taint.is_devicey(node.args[0]):
+                    add(node, f"{leaf}()")
+                    return
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "block_until_ready":
+                    add(node, ".block_until_ready()")
+                elif attr in ("item", "tolist"):
+                    add(node, f".{attr}()")
+
+        def visit_FunctionDef(self, node):  # nested fns: own qual
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+    v = V()
+    for stmt in body:
+        v.visit(stmt)
+    return out
+
+
+def inventory(pkg: Package) -> List[SyncSite]:
+    """Every sync site in the package, classified hot vs. setup."""
+    hot = pkg.reachable(hot_roots(pkg))
+    sites: List[SyncSite] = []
+    for qual in sorted(pkg.functions):
+        sites.extend(_sites_in_function(pkg, qual, qual in hot))
+    return sites
+
+
+def hot_sites(pkg: Package) -> List[SyncSite]:
+    return [s for s in inventory(pkg) if s.hot]
+
+
+def hot_sync_count(pkg: Package) -> int:
+    """Total hot-loop sync sites (annotated or not) — the number bench.py
+    records as `hot_loop_syncs`."""
+    return len(hot_sites(pkg))
+
+
+def hot_site_lines(pkg: Package) -> Dict[str, Set[int]]:
+    """rel -> line numbers of hot sync sites (for the transfer-guard
+    runtime cross-check)."""
+    out: Dict[str, Set[int]] = {}
+    for s in hot_sites(pkg):
+        out.setdefault(s.rel, set()).add(s.line)
+    return out
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for s in inventory(pkg):
+        if s.hot and not s.annotated:
+            findings.append(Finding(
+                "sync-point", s.rel, s.line, s.func, s.code,
+                f"{s.code} on the hot path (reachable from the training "
+                "iteration loop); batch it or annotate "
+                "`# tpulint: sync-ok(<reason>)`"))
+    return findings

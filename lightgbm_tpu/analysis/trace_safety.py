@@ -1,0 +1,383 @@
+"""Rule pack: trace-safety.
+
+Flags implicit tracer concretization inside functions reachable from
+`jax.jit` / `lax.scan` / `shard_map` bodies:
+
+- `np.asarray` / `np.array` / `jax.device_get` / `.item()` / `.tolist()`
+  applied to an expression containing a *traced* value,
+- `float()` / `int()` / `bool()` on a traced expression,
+- Python `if` / `while` whose test reads a traced value directly
+  (a trace-time `TracerBoolConversionError` in waiting),
+- Python `for` iterating a traced array.
+
+"Traced" is a syntactic taint: the non-static parameters of a jit root,
+propagated through name assignments inside the function and through
+name-resolved calls into callees (positional + keyword mapping, run to
+a fixpoint). Shape/metadata reads (`x.shape`, `x.ndim`, `x.dtype`,
+`x.size`, `len(x)`, `x is None`) are exempt — they are static under
+tracing.
+
+Suppress a deliberate site with `# tpulint: trace-ok(<reason>)`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FunctionInfo, Package, dotted
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type", "nbytes"}
+_CONCRETIZING_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_CONCRETIZING = {"asarray", "array", "copy", "save", "savez"}
+_BUILTIN_CONCRETIZING = {"float", "int", "bool", "complex"}
+
+
+def _static_names_from_jit(call: ast.Call, params: List[str]) -> Set[str]:
+    """Parameter names made static by static_argnums/static_argnames."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                                 str):
+                    out.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                                 int):
+                    if 0 <= node.value < len(params):
+                        out.add(params[node.value])
+    return out
+
+
+def _is_jit_name(pkg: Package, rel: str, node: ast.AST) -> Optional[str]:
+    """'jit' | 'scan' | 'shard_map' when `node` names that transform."""
+    d = dotted(node)
+    if d is None:
+        return None
+    imps = pkg.imports[rel]
+    parts = d.split(".")
+    root = parts[0]
+    if parts[-1] == "jit" and (root in imps.jax or root == "jax"
+                               or len(parts) == 1):
+        # jax.jit / <alias>.jit; bare "jit" only if imported from jax
+        if len(parts) == 1 and root != "jit":
+            return None
+        if len(parts) == 1:
+            sym = imps.symbols.get("jit")
+            return None if sym is not None else "jit"
+        return "jit"
+    if parts[-1] == "scan" and (root in imps.jax or "lax" in parts
+                                or root == "lax"):
+        return "scan"
+    if parts[-1] == "shard_map":
+        return "shard_map"
+    return None
+
+
+class _JitRoots:
+    """Jit/scan/shard_map entry functions + their static params."""
+
+    def __init__(self, pkg: Package) -> None:
+        self.pkg = pkg
+        # qual -> set of static param names
+        self.roots: Dict[str, Set[str]] = {}
+        # Lambda nodes used as jit/scan bodies: (rel, lambda node, statics)
+        self.lambdas: List[Tuple[str, ast.Lambda, Set[str]]] = []
+        for rel, sf in pkg.files.items():
+            self._scan_module(rel, sf.tree)
+
+    def _add_target(self, rel: str, caller: Optional[FunctionInfo],
+                    target: ast.AST, statics_call: Optional[ast.Call]
+                    ) -> None:
+        if isinstance(target, ast.Lambda):
+            statics = set()
+            if statics_call is not None:
+                statics = _static_names_from_jit(statics_call,
+                                                 _lambda_params(target))
+            self.lambdas.append((rel, target, statics))
+            return
+        for q in self.pkg.resolve_call(rel, caller, target, fallback=False):
+            fi = self.pkg.functions.get(q)
+            if fi is None:
+                continue
+            statics: Set[str] = set()
+            if statics_call is not None:
+                params = fi.params
+                if fi.cls and params and params[0] == "self":
+                    pass  # static_argnums count from the bound signature
+                statics = _static_names_from_jit(statics_call, params)
+            self.roots.setdefault(q, set()).update(statics)
+
+    def _scan_module(self, rel: str, tree: ast.Module) -> None:
+        pkg = self.pkg
+        # decorators: @jax.jit / @functools.partial(jax.jit, ...) /
+        # @functools.partial(shard_map, ...)
+        for qual, fi in pkg.functions.items():
+            if fi.rel != rel:
+                continue
+            for dec in getattr(fi.node, "decorator_list", []):
+                kind = _is_jit_name(pkg, rel, dec)
+                if kind is not None:
+                    self.roots.setdefault(qual, set())
+                    continue
+                if isinstance(dec, ast.Call):
+                    kind = _is_jit_name(pkg, rel, dec.func)
+                    if kind is not None:
+                        statics = _static_names_from_jit(dec, fi.params)
+                        self.roots.setdefault(qual, set()).update(statics)
+                        continue
+                    # functools.partial(jax.jit, ...) or partial(shard_map,..)
+                    fd = dotted(dec.func)
+                    if fd is not None and fd.split(".")[-1] == "partial" \
+                            and dec.args:
+                        inner = _is_jit_name(pkg, rel, dec.args[0])
+                        if inner is not None:
+                            statics = _static_names_from_jit(dec, fi.params)
+                            self.roots.setdefault(qual, set()).update(statics)
+        # call sites: jax.jit(f, ...), lax.scan(f, ...), shard_map(f, ...)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _is_jit_name(pkg, rel, node.func)
+            caller = pkg.enclosing_function(rel, node)
+            if kind in ("jit", "shard_map") and node.args:
+                self._add_target(rel, caller, node.args[0],
+                                 node if kind == "jit" else None)
+            elif kind == "scan" and node.args:
+                self._add_target(rel, caller, node.args[0], None)
+            else:
+                # partial(shard_map, mesh=...)(body) / partial(jax.jit,..)(f)
+                if isinstance(node.func, ast.Call):
+                    fd = dotted(node.func.func)
+                    if fd is not None and fd.split(".")[-1] == "partial" \
+                            and node.func.args:
+                        inner = _is_jit_name(pkg, rel, node.func.args[0])
+                        if inner is not None and node.args:
+                            self._add_target(rel, caller, node.args[0],
+                                             node.func if inner == "jit"
+                                             else None)
+
+
+def _lambda_params(lam: ast.Lambda) -> List[str]:
+    a = lam.args
+    out = [p.arg for p in getattr(a, "posonlyargs", [])] + \
+        [p.arg for p in a.args]
+    if a.vararg:
+        out.append(a.vararg.arg)
+    out += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        out.append(a.kwarg.arg)
+    return out
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """True when `node` reads a tainted name OUTSIDE the shape/metadata
+    exemptions."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in _SHAPE_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Call):
+        fd = dotted(node.func)
+        if fd is not None and fd.split(".")[0] == "len":
+            return False          # len(traced) is static rank info
+        parts = [node.func] if not isinstance(node.func, ast.Name) else []
+        sub = parts + list(node.args) + [kw.value for kw in node.keywords]
+        return any(_expr_tainted(c, tainted) for c in sub)
+    if isinstance(node, ast.Compare):
+        ops_ok = all(isinstance(op, (ast.Is, ast.IsNot))
+                     for op in node.ops)
+        if ops_ok:
+            return False          # `x is None` style checks are static
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.Load, ast.Store, ast.Del, ast.operator,
+                              ast.cmpop, ast.boolop, ast.unaryop)):
+            continue
+        if _expr_tainted(child, tainted):
+            return True
+    return False
+
+
+class _BodyChecker(ast.NodeVisitor):
+    """Scan one traced function body with a known tainted-name set,
+    updating taint through assignments in source order."""
+
+    def __init__(self, pkg: Package, rel: str, fi_qual: str,
+                 tainted: Set[str], findings: List[Finding],
+                 call_taints: Dict[str, Set[str]],
+                 caller: Optional[FunctionInfo]) -> None:
+        self.pkg = pkg
+        self.rel = rel
+        self.sf = pkg.files[rel]
+        self.qual = fi_qual
+        self.tainted = set(tainted)
+        self.findings = findings
+        self.call_taints = call_taints      # callee qual -> tainted params
+        self.caller = caller
+        self.imps = pkg.imports[rel]
+
+    # -- taint bookkeeping ---------------------------------------------
+    def _taint_targets(self, target: ast.AST) -> None:
+        # `self.x = tainted` must not taint `self` wholesale
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_targets(e)
+        elif isinstance(target, (ast.Starred, ast.Subscript)):
+            self._taint_targets(target.value)
+        elif isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if _expr_tainted(node.value, self.tainted):
+            for t in node.targets:
+                self._taint_targets(t)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if _expr_tainted(node.value, self.tainted):
+            self._taint_targets(node.target)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None and _expr_tainted(node.value, self.tainted):
+            self._taint_targets(node.target)
+
+    # nested defs are separate functions; don't descend
+    def visit_FunctionDef(self, node):  # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: D102
+        pass
+
+    # -- checks ---------------------------------------------------------
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        if self.sf.pragma_at(node.lineno, "trace-ok"):
+            return
+        self.findings.append(Finding("trace-safety", self.rel, node.lineno,
+                                     self.qual, code, message))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        fd = dotted(node.func)
+        args_tainted = any(_expr_tainted(a, self.tainted) for a in node.args)
+        if fd is not None:
+            parts = fd.split(".")
+            root, leaf = parts[0], parts[-1]
+            if root in self.imps.numpy and leaf in _NP_CONCRETIZING \
+                    and args_tainted:
+                self._emit(node, f"np.{leaf}",
+                           f"np.{leaf}() concretizes a traced value inside "
+                           "jitted code")
+                return
+            if leaf == "device_get" and args_tainted:
+                self._emit(node, "device_get",
+                           "jax.device_get() inside traced code forces a "
+                           "sync + concretization")
+                return
+            if len(parts) == 1 and leaf in _BUILTIN_CONCRETIZING \
+                    and args_tainted:
+                self._emit(node, f"{leaf}()",
+                           f"{leaf}() on a traced value raises/concretizes "
+                           "at trace time")
+                return
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _CONCRETIZING_METHODS \
+                and _expr_tainted(node.func.value, self.tainted):
+            self._emit(node, f".{node.func.attr}()",
+                       f".{node.func.attr}() concretizes a traced value "
+                       "inside jitted code")
+            return
+        # propagate taint into CONFIDENTLY resolved package callees:
+        # the simple-name fallback would taint every `add`/`update` in
+        # the package off dict/set method calls
+        for q in self.pkg.resolve_call(self.rel, self.caller, node.func,
+                                       fallback=False):
+            fi = self.pkg.functions.get(q)
+            if fi is None:
+                continue
+            params = fi.params
+            off = 1 if (fi.cls and params and params[0] in ("self", "cls")
+                        and isinstance(node.func, ast.Attribute)) else 0
+            newly: Set[str] = set()
+            for i, a in enumerate(node.args):
+                if i + off < len(params) and _expr_tainted(a, self.tainted):
+                    newly.add(params[i + off])
+            for kw in node.keywords:
+                if kw.arg in params and _expr_tainted(kw.value, self.tainted):
+                    newly.add(kw.arg)
+            if newly - self.call_taints.get(q, set()):
+                self.call_taints.setdefault(q, set()).update(newly)
+
+    def visit_If(self, node: ast.If) -> None:
+        if _expr_tainted(node.test, self.tainted):
+            self._emit(node, "if-traced",
+                       "Python `if` on a traced value (trace-time bool "
+                       "conversion)")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if _expr_tainted(node.test, self.tainted):
+            self._emit(node, "while-traced",
+                       "Python `while` on a traced value")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # `for v in traced:` — iterating a tracer; range(x.shape[0]) is
+        # exempt via the shape-attr exemption inside _expr_tainted
+        if _expr_tainted(node.iter, self.tainted):
+            self._emit(node, "for-traced",
+                       "Python `for` over a traced array (unrolls / "
+                       "concretizes)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if _expr_tainted(node.test, self.tainted):
+            self._emit(node, "assert-traced",
+                       "assert on a traced value")
+        self.generic_visit(node)
+
+
+def traced_functions(pkg: Package) -> Dict[str, Set[str]]:
+    """qual -> tainted params, for every function reachable from a jit/
+    scan/shard_map root (fixpoint over the call graph)."""
+    roots = _JitRoots(pkg)
+    taints: Dict[str, Set[str]] = {}
+    for q, statics in roots.roots.items():
+        fi = pkg.functions[q]
+        params = [p for p in fi.params if p not in ("self", "cls")]
+        taints[q] = {p for p in params if p not in statics}
+    # fixpoint: run body checks only for taint PROPAGATION (findings
+    # discarded), until the callee taint map stops growing
+    for _ in range(6):
+        before = {q: set(s) for q, s in taints.items()}
+        sink: List[Finding] = []
+        for q in list(taints):
+            fi = pkg.functions.get(q)
+            if fi is None:
+                continue
+            chk = _BodyChecker(pkg, fi.rel, q, taints[q], sink, taints, fi)
+            for stmt in fi.node.body if hasattr(fi.node, "body") else []:
+                chk.visit(stmt)
+        if {q: s for q, s in taints.items()} == before:
+            break
+    return taints
+
+
+def check(pkg: Package) -> List[Finding]:
+    taints = traced_functions(pkg)
+    findings: List[Finding] = []
+    for q, tainted in sorted(taints.items()):
+        fi = pkg.functions.get(q)
+        if fi is None or not tainted:
+            continue
+        chk = _BodyChecker(pkg, fi.rel, q, tainted, findings, taints, fi)
+        for stmt in fi.node.body if hasattr(fi.node, "body") else []:
+            chk.visit(stmt)
+    return findings

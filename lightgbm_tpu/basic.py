@@ -11,7 +11,6 @@ Booster wraps the GBDT driver directly.
 from __future__ import annotations
 
 import copy
-import json
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -520,6 +519,7 @@ class Booster:
         k = self._gbdt.num_tree_per_iteration
         if self._gbdt.objective is not None:
             import jax.numpy as jnp
+            # tpulint: sync-ok(eval-path output conversion, once per eval call)
             conv = np.asarray(self._gbdt.objective.convert_output(
                 jnp.asarray(score[0] if k == 1 else score.T)))
             return conv

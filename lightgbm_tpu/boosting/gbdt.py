@@ -15,7 +15,6 @@ program over the score array. The host drives the iteration loop.
 from __future__ import annotations
 
 import functools
-import math
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -494,6 +493,7 @@ class GBDT:
                 refs[(i, "split_gain")] = (
                     src["split_gain"][t.index] if stacked
                     else src["split_gain"])
+        # tpulint: sync-ok(batched tree stats, ONE transfer per stop check)
         fetched = jax.device_get(refs) if refs else {}
         counts, gains = [], []
         for i, t in enumerate(trees):
@@ -590,6 +590,7 @@ class GBDT:
         pend = [(i, t) for i, t in enumerate(self.models)
                 if isinstance(t, PendingTree) and t._tree is None]
         if pend:
+            # tpulint: sync-ok(model materialization, batched; snapshot/finalize only)
             host = jax.device_get([t.tree_arrays for _, t in pend])
             for (_, t), ta in zip(pend, host):
                 t.tree_arrays = ta
@@ -699,6 +700,7 @@ class GBDT:
             eval_set(f"valid_{i}", ms, self.valid_score[i].score)
         if dev_slots:
             # ONE transfer for every device-reduced scalar of this eval
+            # tpulint: sync-ok(batched eval scalars, one transfer per eval)
             vals = jax.device_get([v for _, v in dev_slots])
             for (idx, _), v in zip(dev_slots, vals):
                 out[idx][2] = float(v)
@@ -1007,7 +1009,6 @@ class GBDT:
     def refit_tree(self, tree_leaf_prediction: np.ndarray) -> None:
         """reference GBDT::RefitTree (gbdt.cpp:266): re-fit leaf values
         of the existing structure with new gradients."""
-        from ..ops.split import threshold_l1
         cfg = self.config
         self._pred_revision = getattr(self, "_pred_revision", 0) + 1
         leaf_pred = np.asarray(tree_leaf_prediction, dtype=np.int64)
@@ -1129,7 +1130,6 @@ class DART(GBDT):
                     self.tree_weight[j] *= k_drop / (k_drop + cfg.learning_rate)
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
 def _goss_sample_device(grad, hess, seed, *, top_k: int, other_k: int):
     """Device-side GOSS round (reference goss.hpp:111-147): top_k rows
     by sum_c |g*h|, other_k uniform from the rest upweighted by
@@ -1160,6 +1160,17 @@ def _goss_sample_device(grad, hess, seed, *, top_k: int, other_k: int):
     return grad, hess, perm
 
 
+@functools.lru_cache(maxsize=1)
+def _goss_sample_entry():
+    """Manager-registered entry for the GOSS sampling kernel, so its
+    (re)compiles land in the same compile counters as the rest of the
+    stack instead of hiding behind an ad-hoc module-level jit."""
+    from ..compile import get_manager
+    return get_manager().jit_entry(
+        "boosting/goss_sample",
+        jax.jit(_goss_sample_device, static_argnames=("top_k", "other_k")))
+
+
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (reference goss.hpp:25)."""
 
@@ -1182,7 +1193,7 @@ class GOSS(GBDT):
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, min(int(n * cfg.other_rate), n - top_k))
         seed = jnp.int32(self._bag_rng.randint(1 << 31))
-        self._grad, self._hess, self._perm = _goss_sample_device(
+        self._grad, self._hess, self._perm = _goss_sample_entry()(
             self._grad, self._hess, seed, top_k=top_k, other_k=other_k)
         self.bag_data_cnt = top_k + other_k
 

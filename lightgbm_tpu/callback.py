@@ -15,7 +15,7 @@ loops produce.
 from __future__ import annotations
 
 from collections import OrderedDict, namedtuple
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 from .utils import log
 

@@ -82,7 +82,6 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 def run_train(config: Config, params: Dict[str, str]) -> None:
     import lightgbm_tpu as lgb
-    from .callback import print_evaluation
 
     train_set = lgb.Dataset(config.data, params=dict(params))
     valid_sets = []

@@ -69,28 +69,34 @@ class SharedEntry:
         self.digest = digest
         self._build = build
         self._jfn: Optional[Callable] = None
+        # guards _jfn / _key_cache / specs: entries are shared across
+        # learner instances and warmed up from worker threads
+        self._lock = threading.RLock()
         self._key_cache: Dict[Tuple, str] = {}
         # warmup specs: list of (args_pytree_of_avals, statics_dict)
         self.specs: List[Tuple[Any, Dict[str, Any]]] = []
 
     def jit_fn(self) -> Callable:
-        if self._jfn is None:
-            self._jfn = self._build()
-        return self._jfn
+        with self._lock:
+            if self._jfn is None:
+                self._jfn = self._build()
+            return self._jfn
 
     def add_spec(self, args: Any, statics: Optional[Dict[str, Any]] = None
                  ) -> None:
         statics = dict(statics or {})
-        key = self.key_for(args, statics)
-        if all(self.key_for(a, s) != key for a, s in self.specs):
-            self.specs.append((args, statics))
+        with self._lock:
+            key = self.key_for(args, statics)
+            if all(self.key_for(a, s) != key for a, s in self.specs):
+                self.specs.append((args, statics))
 
     def key_for(self, args: Any, statics: Dict[str, Any]) -> str:
         ss = S.shape_signature(args, statics)
-        key = self._key_cache.get(ss)
-        if key is None:
-            key = S.cache_key(self.digest, ss)
-            self._key_cache[ss] = key
+        with self._lock:
+            key = self._key_cache.get(ss)
+            if key is None:
+                key = S.cache_key(self.digest, ss)
+                self._key_cache[ss] = key
         return key
 
     def __call__(self, *args: Any, **statics: Any) -> Any:

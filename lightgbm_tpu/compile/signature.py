@@ -50,7 +50,7 @@ _IGNORED_CONFIG_FIELDS = frozenset({
     "two_round", "machines", "machine_list_filename", "time_out",
     "verbosity", "metrics_file", "profile_dir", "metrics_interval",
     "timetag", "tpu_warmup", "extra", "task", "data_random_seed",
-    "output_freq", "metric_freq", "is_provide_training_metric",
+    "metric_freq", "is_provide_training_metric",
     "eval_at", "num_machines", "local_listen_port",
 })
 

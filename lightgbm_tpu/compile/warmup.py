@@ -63,7 +63,11 @@ def _pending_specs(mgr: CompileManager
                    ) -> List[Tuple[SharedEntry, str, Any, Dict[str, Any]]]:
     out = []
     for entry in list(mgr.shared.values()):
-        for args, statics in entry.specs:
+        # snapshot under the entry lock: learners may still be
+        # registering specs while a warmup thread walks the list
+        with entry._lock:
+            specs = list(entry.specs)
+        for args, statics in specs:
             key = entry.key_for(args, statics)
             if mgr.executables.get(key) is None:
                 out.append((entry, key, args, statics))

@@ -67,6 +67,7 @@ def _telemetry_end_iteration(telemetry, booster, iteration: int,
     gbdt = booster._gbdt
     extra: Dict[str, Any] = {}
     try:
+        # tpulint: sync-ok(telemetry-only stream sync for honest wall time)
         jax.block_until_ready(gbdt.device_score_state())
     except Exception:
         pass

@@ -12,7 +12,7 @@ TPU.  Bin *application* (value->bin for the full column) is vectorized with
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 

@@ -136,6 +136,7 @@ def allgather_bytes(shard_bufs: np.ndarray, mesh=None) -> np.ndarray:
         jnp.asarray(shard_bufs),
         NamedSharding(mesh, P("data", None)))
 
+    # tpulint: jit-ok(one-shot collective gather; not a training entry)
     @jax.jit
     @lambda f: shard_map(f, mesh=mesh, in_specs=P("data", None),
                          out_specs=P(), check_vma=False)

@@ -29,7 +29,7 @@ histogram gather) has ONE uniform code path.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

@@ -22,7 +22,7 @@ cat_boundaries_, tree.h).
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 import jax
@@ -200,6 +200,7 @@ class PackedForest:
                          self._blocked(self.node_words)))
         return leaves.reshape(-1, x.shape[0])          # [Tpad, N]
 
+    # tpulint: jit-ok(predict-time entry; off the training hot path)
     @functools.partial(jax.jit, static_argnums=0)
     def raw_scores(self, x: jax.Array) -> jax.Array:
         """[num_classes, N] raw scores in one dispatch."""
@@ -211,12 +212,14 @@ class PackedForest:
         return jnp.zeros((k, x.shape[0]), jnp.float32).at[
             self.tree_class].add(vals)
 
+    # tpulint: jit-ok(predict-time entry; off the training hot path)
     @functools.partial(jax.jit, static_argnums=0)
     def leaf_indices(self, x: jax.Array) -> jax.Array:
         """[N, T] leaf index of every row in every tree (reference
         PredictLeafIndex), one dispatch."""
         return self._block_leaves(x)[:self.num_trees].T
 
+    # tpulint: jit-ok(predict-time entry; off the training hot path)
     @functools.partial(jax.jit, static_argnums=(0, 2))
     def raw_scores_early_stop(self, x: jax.Array, freq: int,
                               margin: float) -> jax.Array:

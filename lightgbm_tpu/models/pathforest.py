@@ -29,7 +29,6 @@ walker); no prediction early stop.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence
 
 import numpy as np

@@ -152,6 +152,7 @@ class RegressionL2(ObjectiveFunction):
             self._label_dev = jnp.asarray(self.label)
         self.is_constant_hessian = self.weights is None
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         g = score.astype(jnp.float32) - self._label_dev
@@ -186,6 +187,7 @@ class RegressionL1(RegressionL2):
     name = "regression_l1"
     is_renew_tree_output = True
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         diff = score.astype(jnp.float32) - self._label_dev
@@ -226,6 +228,7 @@ class RegressionHuber(RegressionL2):
         if self.alpha <= 0:
             log.fatal("alpha should be greater than 0 in huber")
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         diff = score.astype(jnp.float32) - self._label_dev
@@ -251,6 +254,7 @@ class RegressionFair(RegressionL2):
         super().__init__(config)
         self.c = config.fair_c
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         x = score.astype(jnp.float32) - self._label_dev
@@ -285,6 +289,7 @@ class RegressionPoisson(RegressionL2):
         if self.label is not None and np.any(self.label < 0):
             log.fatal("[poisson]: at least one target label is negative")
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         s = score.astype(jnp.float32)
@@ -317,6 +322,7 @@ class RegressionQuantile(RegressionL2):
         if not (0.0 < self.alpha < 1.0):
             log.fatal("alpha should be in (0, 1) for quantile")
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         delta = score.astype(jnp.float32) - self._label_dev
@@ -360,6 +366,7 @@ class RegressionMAPE(RegressionL1):
         self._label_weight_dev = jnp.asarray(self.label_weight)
         self.is_constant_hessian = self.weights is None
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         diff = score.astype(jnp.float32) - self._label_dev
@@ -397,6 +404,7 @@ class RegressionMAPE(RegressionL1):
 class RegressionGamma(RegressionPoisson):
     name = "gamma"
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         s = score.astype(jnp.float32)
@@ -419,6 +427,7 @@ class RegressionTweedie(RegressionPoisson):
         super().__init__(config)
         self.rho = config.tweedie_variance_power
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         s = score.astype(jnp.float32)
@@ -475,6 +484,7 @@ class BinaryLogloss(ObjectiveFunction):
         self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
         self.is_constant_hessian = False
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         s = score.astype(jnp.float32)
@@ -545,6 +555,7 @@ class MulticlassSoftmax(ObjectiveFunction):
             (lab[None, :] == np.arange(self.num_class)[:, None]).astype(np.float32))
         self.factor = self.num_class / max(self.num_class - 1, 1)
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         """score: [num_class, N] raw scores; returns [num_class, N] each."""
@@ -607,6 +618,7 @@ class CrossEntropy(ObjectiveFunction):
         if np.any((self.label < 0) | (self.label > 1)):
             log.fatal("[%s]: label must be in [0, 1]", self.name)
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         z = 1.0 / (1.0 + jnp.exp(-score.astype(jnp.float32)))
@@ -645,6 +657,7 @@ class CrossEntropyLambda(ObjectiveFunction):
         if np.any((self.label < 0) | (self.label > 1)):
             log.fatal("[%s]: label must be in [0, 1]", self.name)
 
+    # tpulint: jit-ok(per-objective gradient kernel; static self, stable arity)
     @functools.partial(jax.jit, static_argnums=0)
     def get_gradients(self, score):
         """Reference xentropy_objective.hpp:185-213: unweighted variant

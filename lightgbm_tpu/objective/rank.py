@@ -18,7 +18,7 @@ The truncation level enters only through CalMaxDCGAtK
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 import jax
@@ -136,6 +136,7 @@ class LambdarankNDCG(RankingObjective):
         self._gain_dev = jnp.asarray(self.dcg.label_gain, jnp.float32)
         self._disc_dev = None  # built per bucket size
 
+    # tpulint: jit-ok(rank lambda kernel; static self, stable bucket shapes)
     @functools.partial(jax.jit, static_argnums=(0,))
     def _chunk_lambdas(self, score, idx, valid, inv_max_dcg):
         """One padded bucket: [Q, M] gathered scores/labels → lambdas."""
@@ -202,6 +203,7 @@ class RankXENDCG(RankingObjective):
         super().init(metadata, num_data)
         self._rng = np.random.RandomState(self.seed)
 
+    # tpulint: jit-ok(rank lambda kernel; static self, stable bucket shapes)
     @functools.partial(jax.jit, static_argnums=(0,))
     def _chunk_lambdas(self, score, idx, valid, rands):
         """reference RankXENDCG::GetGradientsForOneQuery

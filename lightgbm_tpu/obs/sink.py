@@ -19,7 +19,10 @@ Iteration record (v1.2):
             quantized-gradient pipeline fields: "hist.quant_*"
             counters under `counters` — requantize passes, packed
             collective bytes moved, per-leaf overflow escalations —
-            and the "hist.quant_bins" gauge under `gauges`),
+            and the "hist.quant_bins" gauge under `gauges`; minor 3
+            adds the tpulint static-analysis gauges "lint.findings" /
+            "lint.baseline_size" under `gauges` and the
+            "hot_loop_syncs" bench summary field),
             phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
             metrics (object: "<dataset>/<metric>" -> number),
@@ -31,13 +34,14 @@ driver artifacts wrap it under a "parsed" key).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 SCHEMA_VERSION = 1
 # additive revision within SCHEMA_VERSION (see module docstring); bumped
 # to 1 when the compile-manager counters/timers joined the record, to 2
-# when the quantized-gradient hist.quant_* counters/gauges joined
-SCHEMA_MINOR = 2
+# when the quantized-gradient hist.quant_* counters/gauges joined, to 3
+# when the tpulint lint.* gauges and hot_loop_syncs bench field joined
+SCHEMA_MINOR = 3
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -51,7 +55,9 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "aot_store_loads", "aot_compile_s",
                        # quantized-gradient pipeline (schema minor 2)
                        "quantized", "num_grad_quant_bins",
-                       "iter_p50_s", "iter_p90_s", "hist_share")
+                       "iter_p50_s", "iter_p90_s", "hist_share",
+                       # static hot-loop sync inventory (schema minor 3)
+                       "hot_loop_syncs")
 # optional string-typed bench keys (minor 2): histogram kernel variant
 _BENCH_OPTIONAL_STR = ("hist_method",)
 

@@ -101,6 +101,7 @@ def _hist_pallas_kernel(bins_ref, grad_ref, hess_ref, out_ref, *, num_bins: int)
     jax.lax.fori_loop(0, num_bins, body, ())
 
 
+# tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "rows_per_block", "interpret"))
 def histogram_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -162,6 +163,7 @@ def _radix_dims(num_bins: int) -> tuple:
     return bh_bits, bl_bits
 
 
+# tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit, static_argnames=("num_bins", "dtype", "row_chunk"))
 def histogram_radix(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     num_bins: int, dtype=jnp.float32,
@@ -343,6 +345,7 @@ def _radix_pallas_kernel(codes_t_ref, gh_t_ref, out_ref, *, CC, Fc,
                   bl_bits=bl_bits, dtype=dtype, int_out=int_out)
 
 
+# tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit, static_argnames=("num_bins", "dtype",
                                              "rows_per_block", "interpret"))
 def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -491,6 +494,7 @@ def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
                       bl_bits=bl_bits, dtype=dtype, int_out=quant)
 
 
+# tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
                                              "code_bits", "grad_plane",
                                              "cap", "dtype",

@@ -60,6 +60,7 @@ def _decision_go_left(binval, threshold, default_left, miss_bin, is_cat,
     return jnp.where(is_miss, default_left, dec)
 
 
+# tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit, static_argnames=("capacity",))
 def partition_leaf(bins_full: jax.Array, perm: jax.Array, start, count,
                    feature, threshold, default_left, miss_bin, is_cat,

@@ -38,7 +38,6 @@ flattened candidate axis before the argmax.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional
 
 import jax

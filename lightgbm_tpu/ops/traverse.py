@@ -30,6 +30,7 @@ def _bitset_lookup(bitset: jax.Array, boundaries: jax.Array, cat_idx, val):
     return (bit == 1) & in_range
 
 
+# tpulint: jit-ok(prediction traversal kernel; off the training hot path)
 @functools.partial(jax.jit, static_argnames=())
 def traverse_binned(bins: jax.Array, split_feature: jax.Array,
                     threshold_bin: jax.Array, left_child: jax.Array,
@@ -83,6 +84,7 @@ def traverse_binned(bins: jax.Array, split_feature: jax.Array,
     return -node - 1
 
 
+# tpulint: jit-ok(prediction traversal kernel; off the training hot path)
 @functools.partial(jax.jit, static_argnames=())
 def traverse_raw(x: jax.Array, split_feature: jax.Array,
                  threshold: jax.Array, left_child: jax.Array,

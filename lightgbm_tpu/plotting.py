@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-from .basic import Booster, LightGBMError
+from .basic import Booster
 from .sklearn import LGBMModel
 
 

@@ -8,14 +8,12 @@ translators).
 """
 from __future__ import annotations
 
-import copy
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from .basic import Booster, Dataset, LightGBMError
 from .engine import train
-from .utils import log
 
 
 class _ObjectiveFunctionWrapper:

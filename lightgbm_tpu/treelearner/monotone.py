@@ -18,7 +18,7 @@ resulting [cmin, cmax] bounds feed the device split scan.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

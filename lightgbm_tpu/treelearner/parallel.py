@@ -48,7 +48,7 @@ from ..ops.partition import next_capacity
 from ..ops.partition import _decision_go_left
 from ..utils import log
 from .serial import SerialTreeGrower, _Leaf
-from .fused import FusedSerialGrower, fused_supported
+from .fused import FusedSerialGrower
 
 
 def shard_bag_permutation(perm, bag_cnt: int, num_shards: int,
@@ -279,7 +279,9 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 jnp.asarray(grad_np), jnp.asarray(hess_np),
                 cfg.num_grad_quant_bins, key, cfg.stochastic_rounding)
             self._qscales = (gs, hs)
-            self._qscales_host = (float(gs), float(hs))
+            # tpulint: sync-ok(per-tree quant scales, single batched transfer)
+            gsh, hsh = jax.device_get((gs, hs))
+            self._qscales_host = (float(gsh), float(hsh))
             if cfg.quant_train_renew_leaf:
                 raw_g_sh = jax.device_put(
                     jnp.asarray(grad_np.reshape(d, rps)), self._spec_rows)
@@ -303,11 +305,13 @@ class DataParallelTreeGrower(SerialTreeGrower):
             cap, int(counts0.sum()),
             self.bins_sharded, perm_sh, jnp.asarray(starts0),
             jnp.asarray(counts0), g_sh, h_sh)
+        # tpulint: sync-ok(per-tree root stats, single batched transfer)
+        sg, sh = map(float, jax.device_get((sg, sh)))
         if self._qscales is not None:
             # int32 level sums -> dequantized f32 leaf totals
-            sg = float(sg) * self._qscales_host[0]
-            sh = float(sh) * self._qscales_host[1]
-        root = _Leaf(starts0, counts0, float(sg), float(sh), 0.0, 0)
+            sg *= self._qscales_host[0]
+            sh *= self._qscales_host[1]
+        root = _Leaf(starts0, counts0, sg, sh, 0.0, 0)
         root.hist = hist
         root.best = self._compute_best_dp(root, tree_mask,
                                           set() if self._interaction_sets else None,
@@ -353,6 +357,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
         dd = jnp.arange(self.num_shards, dtype=jnp.int32)[None, :]
         e_idx = jnp.asarray(np.maximum(ends, 0), jnp.int32)
         lo_idx = jnp.asarray(np.maximum(los, 0), jnp.int32)
+        # tpulint: sync-ok(per-tree leaf renewal, already one batched transfer)
         ge, he, gl, hl = jax.device_get(
             (cg[dd, e_idx], ch[dd, e_idx], cg[dd, lo_idx], ch[dd, lo_idx]))
         has = counts > 0
@@ -427,6 +432,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
             self.bins_sharded, perm_sh, jnp.asarray(leaf.start),
             jnp.asarray(leaf.count), jnp.int32(fi), jnp.int32(thr),
             bool(dl), jnp.int32(mb), bool(is_cat), cat_bitset_dev)
+        # tpulint: sync-ok(per-shard partition counts steer the host loop)
         lc = np.asarray(left_counts, dtype=np.int32)
         rc = leaf.count - lc
 

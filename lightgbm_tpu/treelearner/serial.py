@@ -342,16 +342,20 @@ class SerialTreeGrower:
         # of feature 0), so out-of-bag rows never contribute — the
         # reference computes these in LeafSplits::Init over bag indices
         if self._quant:
-            # leaf totals live in dequantized f32 units host-side
-            self._qscales_host = (float(self._qscales[0]),
-                                  float(self._qscales[1]))
-            root.sum_g = float(jnp.sum(root.hist[0, :, 0])) \
-                * self._qscales_host[0]
-            root.sum_h = float(jnp.sum(root.hist[0, :, 1])) \
-                * self._qscales_host[1]
+            # leaf totals live in dequantized f32 units host-side; ONE
+            # transfer for the two quant scales and both root sums
+            # tpulint: sync-ok(per-tree root stats, single batched transfer)
+            gsh, hsh, sg, sh = jax.device_get(
+                (self._qscales[0], self._qscales[1],
+                 jnp.sum(root.hist[0, :, 0]), jnp.sum(root.hist[0, :, 1])))
+            self._qscales_host = (float(gsh), float(hsh))
+            root.sum_g = float(sg) * self._qscales_host[0]
+            root.sum_h = float(sh) * self._qscales_host[1]
         else:
-            root.sum_g = float(jnp.sum(root.hist[0, :, 0]))
-            root.sum_h = float(jnp.sum(root.hist[0, :, 1]))
+            # tpulint: sync-ok(per-tree root stats, single batched transfer)
+            sg, sh = jax.device_get((jnp.sum(root.hist[0, :, 0]),
+                                     jnp.sum(root.hist[0, :, 1])))
+            root.sum_g, root.sum_h = float(sg), float(sh)
         leaves: Dict[int, _Leaf] = {0: root}
         if self._forced_splits is not None:
             perm = self._apply_forced_splits(tree, leaves, perm, grad, hess)
@@ -400,6 +404,7 @@ class SerialTreeGrower:
                            jnp.int32)
         los = np.asarray([lf.start - 1 for _, lf in items])
         lo_idx = jnp.asarray(np.maximum(los, 0), jnp.int32)
+        # tpulint: sync-ok(per-tree leaf renewal, already one batched transfer)
         ge, he, gl, hl = jax.device_get(
             (cg[ends], ch[ends], cg[lo_idx], ch[lo_idx]))
         has_lo = los >= 0
@@ -453,6 +458,10 @@ class SerialTreeGrower:
             vec, ivec, cat = self._split_jit(*args, self._qscales)
         else:
             vec, ivec, cat = self._split_jit(*args)
+        # per-leaf best-split readback: ONE transfer for the packed
+        # split vector, its int lanes, and the categorical block
+        # tpulint: sync-ok(per-leaf split readback, single batched transfer)
+        vec, ivec, cat = jax.device_get((vec, ivec, cat))
         v = np.asarray(vec, dtype=np.float64)
         iv = np.asarray(ivec, dtype=np.int64)
         if drop_after:
@@ -520,6 +529,7 @@ class SerialTreeGrower:
             self.bins, perm, jnp.int32(leaf.start), jnp.int32(leaf.count),
             jnp.int32(fi), jnp.int32(thr), bool(dl), jnp.int32(mb),
             bool(is_cat), cat_bitset_dev)
+        # tpulint: sync-ok(partition count steers the host grow loop)
         lc = int(left_count)
         rc = leaf.count - lc
 
@@ -623,6 +633,7 @@ class SerialTreeGrower:
                 leaf.hist = self._hist_fn(cap)(
                     self.bins, perm, jnp.int32(leaf.start),
                     jnp.int32(leaf.count), grad, hess)
+            # tpulint: sync-ok(forced-splits path, config-gated and rare)
             hist = np.asarray(leaf.hist[inner], dtype=np.float64)  # [B, 2]
             if self._quant:
                 # level-sums → f32 units to match leaf.sum_g/sum_h

@@ -10,7 +10,8 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   compile/aot_load/aot_serialize phase timers; v1.2 adds the
   quantized-gradient `hist.quant_*` counters — requantize passes,
   packed collective bytes, overflow escalations — and the
-  `hist.quant_bins` gauge),
+  `hist.quant_bins` gauge; v1.3 adds the tpulint `lint.findings` /
+  `lint.baseline_size` gauges and the `hot_loop_syncs` bench field),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py
