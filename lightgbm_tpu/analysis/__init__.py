@@ -1,6 +1,7 @@
 """tpulint — project-specific static analysis for lightgbm_tpu.
 
-Four rule packs over a plain-`ast` model of the package (core.py):
+Seven rule packs over a plain-`ast` model of the package (core.py).
+Host-side (PR 4):
 
 - trace-safety      implicit tracer concretization inside jitted code
 - sync-point        un-annotated host syncs on the training hot path
@@ -9,6 +10,16 @@ Four rule packs over a plain-`ast` model of the package (core.py):
                     AOT signature
 - lock-discipline   attributes mutated both under and outside a class's
                     `with self._lock`
+
+Device-side ("meshlint", sharing the same call graph, pragmas, and
+baseline):
+
+- collective-axis   collectives outside any shard_map/pmap body, axis
+                    typos vs the mesh inventory, packed-psum contract
+- kernel-contract   BlockSpec tiling/divisibility, out_shape dtype vs
+                    kernel stores, raw memory spaces, bitcast widths
+- dtype-flow        narrow-dtype accumulation and dequantize-before-
+                    subtract in the quantized histogram pipeline
 
 Run `python -m lightgbm_tpu.analysis` (exit 0 = clean against the
 checked-in baseline), or call `run()` programmatically. The rule
@@ -29,7 +40,8 @@ from .core import (  # noqa: F401  (re-exported API)
     load_baseline,
     save_baseline,
 )
-from . import locks, recompile, sync_points, trace_safety
+from . import (collective_axis, dtype_flow, kernel_contract, locks,
+               recompile, sync_points, trace_safety)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
@@ -39,6 +51,16 @@ RULE_PACKS = {
     "sync-point": sync_points.check,
     "recompile-hazard": recompile.check,
     "lock-discipline": locks.check,
+    "collective-axis": collective_axis.check,
+    "kernel-contract": kernel_contract.check,
+    "dtype-flow": dtype_flow.check,
+}
+
+# rule name -> per-pack obs gauge (schema minor 4)
+_PACK_GAUGES = {
+    "collective-axis": "lint.mesh_findings",
+    "kernel-contract": "lint.tile_findings",
+    "dtype-flow": "lint.dtype_findings",
 }
 
 
@@ -96,8 +118,10 @@ def run(root: Optional[str] = None,
         pkg: Optional[Package] = None) -> RunResult:
     """Analyze the package and apply the baseline.
 
-    Publishes `lint.findings` / `lint.baseline_size` gauges to the
-    active obs registry (schema minor 3) when one is installed.
+    Publishes `lint.findings` / `lint.baseline_size` gauges (schema
+    minor 3) and the per-pack meshlint gauges `lint.mesh_findings` /
+    `lint.tile_findings` / `lint.dtype_findings` (schema minor 4) to
+    the active obs registry when one is installed.
     """
     if pkg is None:
         pkg = Package.load(root)
@@ -114,6 +138,12 @@ def run(root: Optional[str] = None,
         if reg is not None:
             reg.set_gauge("lint.findings", float(len(findings)))
             reg.set_gauge("lint.baseline_size", float(result.baseline_size))
+            by_rule: Dict[str, int] = {}
+            for f in findings:
+                by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+            for rule, gauge in _PACK_GAUGES.items():
+                if rules is None or rule in rules:
+                    reg.set_gauge(gauge, float(by_rule.get(rule, 0)))
     except Exception:
         pass
     return result

@@ -39,7 +39,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="comma-separated rule subset: "
                          + ",".join(RULE_PACKS) + ",pragma")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json (CI artifacts)")
     args = ap.parse_args(argv)
+    if args.json:
+        args.format = "json"
 
     rules = args.rules.split(",") if args.rules else None
     if args.write_baseline:
@@ -60,6 +64,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.format == "json":
         print(json.dumps({
             "ok": result.ok,
+            "by_rule": summary(result),
             "new": [vars(f) for f in result.new],
             "baselined": len(result.baselined),
             "baseline_size": result.baseline_size,

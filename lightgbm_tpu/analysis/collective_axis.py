@@ -1,0 +1,189 @@
+"""Rule pack: collective-axis.
+
+Device collectives (`lax.psum` / `pmax` / `pmin` / `pmean` /
+`all_gather` / `ppermute` / `psum_scatter` / `all_to_all` /
+`axis_index`) only work inside a mapped region that binds their axis
+name; outside one they raise `NameError: unbound axis` — but only at
+trace time on the real topology, which CI never exercises. Three
+checks, all against the shared mesh inventory (mesh_inventory.py):
+
+- **axis-unknown** — a literal axis name no mesh in the package
+  defines and no partition spec mentions: almost always a typo
+  (`"dat"` for `"data"`). Dynamic mesh axes (`f"axis{i}"`) are
+  accepted by pattern.
+- **unmapped-collective** — the collective's enclosing function is not
+  reachable (call graph, over-approximating fallback) from any
+  `shard_map`/`pmap` body. Attribute axis arguments
+  (`self.psum_axis`) are resolved through package-wide
+  `self.<attr> = <const>` assignments; a site whose every resolved
+  value is `None` is a guarded no-op and exempt.
+- **quantize-contract** — the packed-int32 collective trick
+  (`ops/quantize.py`) requires summing the *packed* words:
+  `psum(packed_hist_to_pairs(x))` / `psum(unpack_gh(x))` ships the
+  unpacked pairs (2x the bytes, f32 on the wire), and
+  `pairs_to_packed_hist(psum(...))` / `pack_gh(psum(...))` packs after
+  the reduction — both break the contract
+  `packed_hist_to_pairs(psum(pairs_to_packed_hist(h), axis))`.
+
+Suppress a deliberate site with `# tpulint: mesh-ok(<reason>)`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Package, dotted
+from .mesh_inventory import (AxisInventory, axis_inventory, mapped_bodies,
+                             self_attr_constants)
+
+# collective leaf name -> positional index of the axis-name argument
+_COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1,
+    "all_gather": 1, "ppermute": 1, "psum_scatter": 1, "all_to_all": 1,
+    "axis_index": 0,
+}
+
+_QUANTIZE_REL = "lightgbm_tpu/ops/quantize.py"
+_UNPACKERS = ("packed_hist_to_pairs", "unpack_gh")
+_PACKERS = ("pairs_to_packed_hist", "pack_gh")
+
+
+def _collective_leaf(pkg: Package, rel: str, node: ast.AST) -> Optional[str]:
+    """Collective name when `node` is a jax/lax spelling of one."""
+    d = dotted(node)
+    if d is None:
+        return None
+    parts = d.split(".")
+    leaf = parts[-1]
+    if leaf not in _COLLECTIVES:
+        return None
+    root = parts[0]
+    imps = pkg.imports[rel]
+    if root in imps.jax or root == "lax" or "lax" in parts[:-1]:
+        return leaf
+    return None
+
+
+def _axis_arg(call: ast.Call, leaf: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    pos = _COLLECTIVES[leaf]
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def _is_quantize_fn(pkg: Package, rel: str, caller, node: ast.AST,
+                    names) -> bool:
+    """Does `node` name one of ops/quantize.py's `names`?"""
+    d = dotted(node)
+    if d is None or d.split(".")[-1] not in names:
+        return False
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        if isinstance(node, ast.Call):
+            return False
+        quals = pkg.resolve_call(rel, caller, node, fallback=False)
+        if quals:
+            return any(q.split("::")[0].endswith("ops/quantize.py")
+                       for q in quals)
+    # unresolved but exact leaf-name match: trust the name
+    return True
+
+
+def check(pkg: Package) -> List[Finding]:
+    inv: AxisInventory = axis_inventory(pkg)
+    roots = mapped_bodies(pkg)
+    in_mapped: Set[str] = pkg.reachable(roots) if roots else set()
+    attr_consts = self_attr_constants(pkg)
+    findings: List[Finding] = []
+
+    for rel in sorted(pkg.files):
+        sf = pkg.files[rel]
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _collective_leaf(pkg, rel, node.func)
+            caller = pkg.enclosing_function(rel, node)
+            qual = caller.qual if caller else ""
+            if leaf is None:
+                # pack-after-psum: a quantize packer applied to a
+                # collective's result (the packer itself is not a
+                # collective, so it is handled before the skip)
+                if _is_quantize_fn(pkg, rel, caller, node.func, _PACKERS) \
+                        and node.args and isinstance(node.args[0], ast.Call):
+                    inner = _collective_leaf(pkg, rel, node.args[0].func)
+                    if inner in ("psum", "psum_scatter") \
+                            and not pkg.files[rel].pragma_at(node.lineno,
+                                                             "mesh-ok"):
+                        findings.append(Finding(
+                            "collective-axis", rel, node.lineno, qual,
+                            "pack-after-psum",
+                            f"packing the result of lax.{inner} — the "
+                            "packed-int32 contract reduces packed words, "
+                            "not pairs; pack before the collective"))
+                continue
+
+            def emit(code: str, message: str) -> None:
+                if sf.pragma_at(node.lineno, "mesh-ok"):
+                    return
+                findings.append(Finding("collective-axis", rel, node.lineno,
+                                        qual, code, message))
+
+            # -- resolve the axis argument -------------------------------
+            axis_node = _axis_arg(node, leaf)
+            axis_names: List[str] = []
+            guarded_none = False
+            resolved = False
+            if isinstance(axis_node, ast.Constant):
+                resolved = True
+                if isinstance(axis_node.value, str):
+                    axis_names = [axis_node.value]
+                elif axis_node.value is None:
+                    guarded_none = True
+            elif isinstance(axis_node, ast.Attribute) \
+                    and isinstance(axis_node.value, ast.Name) \
+                    and axis_node.value.id == "self":
+                vals = attr_consts.get(axis_node.attr)
+                if vals is not None and Ellipsis not in vals:
+                    resolved = True
+                    axis_names = [v for v in vals if isinstance(v, str)]
+                    guarded_none = None in vals
+            # tuple/list axes: check each literal element
+            elif isinstance(axis_node, (ast.Tuple, ast.List)):
+                resolved = all(isinstance(e, ast.Constant)
+                               for e in axis_node.elts)
+                axis_names = [e.value for e in axis_node.elts
+                              if isinstance(e, ast.Constant)
+                              and isinstance(e.value, str)]
+
+            # -- axis-unknown -------------------------------------------
+            for name in axis_names:
+                if not inv.permits(name):
+                    emit(f"axis-unknown:{name}",
+                         f"lax.{leaf} names axis '{name}' which no Mesh "
+                         "or partition spec in the package defines "
+                         "(typo?)")
+
+            # -- unmapped-collective ------------------------------------
+            # A site whose only resolved axis value is None is guarded
+            # (`if self.psum_axis is None: return x`) and exempt; an
+            # unresolvable axis argument is skipped, not guessed.
+            if resolved and axis_names and qual and qual not in in_mapped:
+                emit("unmapped-collective",
+                     f"lax.{leaf}(axis='{axis_names[0]}') is not reachable "
+                     "from any shard_map/pmap body — unbound axis at "
+                     "trace time on a real mesh")
+            del guarded_none  # documented above; no separate finding
+
+            # -- quantize-contract --------------------------------------
+            if leaf in ("psum", "psum_scatter") and node.args:
+                operand = node.args[0]
+                if isinstance(operand, ast.Call) and _is_quantize_fn(
+                        pkg, rel, caller, operand.func, _UNPACKERS):
+                    emit("psum-of-unpacked",
+                         "psum of just-unpacked histogram pairs ships 2x "
+                         "the bytes; reduce the packed int32 words: "
+                         "packed_hist_to_pairs(psum(pairs_to_packed_hist"
+                         "(h), axis))")
+    return findings

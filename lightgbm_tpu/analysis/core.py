@@ -20,7 +20,8 @@ Pragmas
 -------
 `# tpulint: <kind>(<reason>)` on the offending line, or alone on the
 line directly above it. Kinds: `sync-ok`, `jit-ok`, `trace-ok`,
-`lock-ok`. The reason is mandatory — a bare pragma is itself a finding.
+`lock-ok`, plus the meshlint kinds `mesh-ok`, `tile-ok`, `dtype-ok`.
+The reason is mandatory — a bare pragma is itself a finding.
 
 Findings & baseline
 -------------------
@@ -40,7 +41,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 PRAGMA_RE = re.compile(r"#\s*tpulint:\s*([a-z-]+)\s*(?:\(\s*([^)]*?)\s*\))?")
-PRAGMA_KINDS = ("sync-ok", "jit-ok", "trace-ok", "lock-ok")
+PRAGMA_KINDS = ("sync-ok", "jit-ok", "trace-ok", "lock-ok",
+                "mesh-ok", "tile-ok", "dtype-ok")
 
 # numpy / jax module spellings recognized as import roots
 _NUMPY_MODULES = ("numpy",)
@@ -345,11 +347,18 @@ class Package:
             if name in self.class_bases.get(rel, {}):
                 q = f"{rel}::{name}.__init__"
                 return {q} if q in self.functions else set()
-            # local nested function of the caller
+            # nested function visible from the caller's scope: its own
+            # children first, then each enclosing function scope (a
+            # sibling closure like `body` next to a shard_map-wrapped
+            # `fn_args`). Stops above the outermost function — a bare
+            # name can't reach class scope.
             if caller is not None:
-                q = f"{rel}::{caller.qual.split('::', 1)[1]}.{name}"
-                if q in self.functions:
-                    return {q}
+                path = caller.qual.split("::", 1)[1].split(".")
+                floor = 1 if caller.cls else 0
+                for i in range(len(path), floor, -1):
+                    q = f"{rel}::{'.'.join(path[:i] + [name])}"
+                    if q in self.functions:
+                        return {q}
             return set()
         if isinstance(func_expr, ast.Attribute):
             attr = func_expr.attr

@@ -1,0 +1,233 @@
+"""Rule pack: dtype-flow.
+
+Taint-tracks the f32 -> int16 -> packed-int32 -> f32 conversions of the
+quantized histogram pipeline (`ops/quantize.py`) through each function
+body and flags the orderings that silently lose precision:
+
+- **narrow-sum** — `jnp.sum(x)` / `x.sum()` on a value known to be
+  int16/int8/uint16/uint8/bfloat16 without a `dtype=` widening kwarg:
+  jnp reductions accumulate in the *input* dtype, so a histogram of
+  int16 gradients overflows at 2^15.
+- **packed-as-float** — `.astype(float32)` on a packed gh word
+  (`pack_gh` / `pairs_to_packed_hist` result): a *value* cast of bit-
+  packed fields is meaningless; unpack first (`unpack_gh` /
+  `packed_hist_to_pairs`), or bitcast if the raw bits are wanted.
+- **dequant-before-subtract** — both operands of a subtraction were
+  separately converted int -> float before the subtract: the sibling-
+  histogram trick is exact only in int32
+  (`parent - sibling` THEN dequantize); in f32 the rounding of two
+  large nearly-equal sums cancels catastrophically.
+- **accum-downcast** — `acc.at[i].add(v)` where `acc` is known narrow
+  (int16/int8) and `v` known wider (int32/f32): every add round-trips
+  through the narrow dtype regardless of v's precision.
+
+Tracking is per-function and syntactic: dtypes come from `.astype(T)`,
+`jnp.zeros/ones/full/empty(..., dtype=T)`, and the quantize-pipeline
+producers (`pack_gh`/`pairs_to_packed_hist` -> packed,
+`unpack_gh` -> int16 pair, `packed_hist_to_pairs` -> int32,
+`quantize_gradients` -> int16s). No interprocedural guessing — a dtype
+the pack can't prove is not flagged.
+
+Suppress a deliberate site with `# tpulint: dtype-ok(<reason>)`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Finding, Package, dotted
+
+_NARROW = {"int16", "uint16", "int8", "uint8", "bfloat16", "float16"}
+_WIDE = {"int32", "uint32", "int64", "float32", "float64"}
+_FLOAT = {"float32", "float64", "bfloat16", "float16"}
+_INT = {"int8", "uint8", "int16", "uint16", "int32", "uint32", "int64"}
+
+# quantize-pipeline producers -> dtype marker of their result
+_PRODUCERS = {
+    "pack_gh": "packed",
+    "pairs_to_packed_hist": "packed",
+    "packed_hist_to_pairs": "int32",
+    "unpack_gh": "int16",            # (qg, qh) int16 pair
+    "quantize_gradients": "int16",
+}
+
+_ZERO_MAKERS = {"zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+                "full_like", "empty_like"}
+
+
+def _walk_local(fn_node: ast.AST):
+    """ast.walk (breadth-first, so same-level statements keep source
+    order — assignment recording depends on it) without descending
+    into nested function/class defs: those are separate FunctionInfos
+    and get their own checker."""
+    from collections import deque
+    queue = deque(ast.iter_child_nodes(fn_node))
+    while queue:
+        node = queue.popleft()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        queue.extend(ast.iter_child_nodes(node))
+
+
+def _dtype_leaf(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    d = dotted(node)
+    if d is not None:
+        leaf = d.split(".")[-1]
+        if leaf in _NARROW | _WIDE or leaf in ("float32", "int32"):
+            return leaf
+    return None
+
+
+class _FnChecker:
+    """One function body: assignment-ordered dtype map + checks."""
+
+    def __init__(self, pkg: Package, rel: str, qual: str,
+                 fn_node: ast.AST, findings: List[Finding]) -> None:
+        self.pkg = pkg
+        self.rel = rel
+        self.sf = pkg.files[rel]
+        self.qual = qual
+        self.fn = fn_node
+        self.findings = findings
+        self.dtype: Dict[str, str] = {}
+
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        if self.sf.pragma_at(node.lineno, "dtype-ok"):
+            return
+        self.findings.append(Finding("dtype-flow", self.rel, node.lineno,
+                                     self.qual, code, message))
+
+    # -- dtype of an expression, from the map + producing calls ---------
+    def _dtype_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.dtype.get(expr.id)
+        if isinstance(expr, ast.Call):
+            # x.astype(T)
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "astype" and expr.args:
+                return _dtype_leaf(expr.args[0])
+            d = dotted(expr.func)
+            leaf = d.split(".")[-1] if d else None
+            if leaf in _PRODUCERS:
+                return _PRODUCERS[leaf]
+            if leaf in _ZERO_MAKERS:
+                for kw in expr.keywords:
+                    if kw.arg == "dtype":
+                        return _dtype_leaf(kw.value)
+                if len(expr.args) > 1:
+                    return _dtype_leaf(expr.args[1])
+        if isinstance(expr, ast.Subscript):
+            return self._dtype_of(expr.value)
+        return None
+
+    def _was_int(self, expr: ast.AST) -> bool:
+        """Did `expr` convert an int value to float right here
+        (`<int>.astype(float)`), or is it a name assigned that way?"""
+        if isinstance(expr, ast.Name):
+            return self.dtype.get(expr.id) == "float-from-int"
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr == "astype" and expr.args:
+            dst = _dtype_leaf(expr.args[0])
+            src = self._dtype_of(expr.func.value)
+            return dst in _FLOAT and (src in _INT or src == "packed")
+        return False
+
+    # -- per-statement walk ---------------------------------------------
+    def _record_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        dt = self._dtype_of(node.value)
+        if self._was_int(node.value):
+            dt = "float-from-int"
+        if dt is None:
+            return
+        if isinstance(tgt, ast.Name):
+            self.dtype[tgt.id] = dt
+        elif isinstance(tgt, ast.Tuple) and dt in ("int16",):
+            # qg, qh = unpack_gh(w) / quantize_gradients(...)
+            for e in tgt.elts:
+                if isinstance(e, ast.Name):
+                    self.dtype[e.id] = dt
+
+    def _check_call(self, node: ast.Call) -> None:
+        d = dotted(node.func)
+        leaf = d.split(".")[-1] if d else None
+        # narrow-sum: jnp.sum(x) / x.sum() without dtype=
+        if leaf in ("sum", "cumsum", "prod"):
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            operand: Optional[ast.AST] = None
+            if isinstance(node.func, ast.Attribute):
+                root = d.split(".")[0] if d else None
+                imps = self.pkg.imports[self.rel]
+                if root in (imps.jnp | imps.numpy | imps.jax):
+                    operand = node.args[0] if node.args else None
+                else:
+                    operand = node.func.value      # x.sum()
+            if operand is not None and not has_dtype:
+                dt = self._dtype_of(operand)
+                if dt in _NARROW:
+                    self._emit(node, f"narrow-sum:{dt}",
+                               f"{leaf}() over a {dt} value accumulates "
+                               f"in {dt} (jnp reductions keep the input "
+                               "dtype) — pass dtype=jnp.int32/float32")
+        # packed-as-float: <packed>.astype(float)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" and node.args:
+            dst = _dtype_leaf(node.args[0])
+            src = self._dtype_of(node.func.value)
+            if src == "packed" and dst in _FLOAT:
+                self._emit(node, "packed-as-float",
+                           "value-cast of a packed gh word to float — "
+                           "unpack first (packed_hist_to_pairs/unpack_gh) "
+                           "or bitcast for raw bits")
+        # accum-downcast: acc.at[i].add(v)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("add", "set") and node.args:
+            base = node.func.value
+            if isinstance(base, ast.Subscript) \
+                    and isinstance(base.value, ast.Attribute) \
+                    and base.value.attr == "at":
+                acc_dt = self._dtype_of(base.value.value)
+                val_dt = self._dtype_of(node.args[0])
+                if acc_dt in _NARROW and val_dt in _WIDE:
+                    self._emit(node, f"accum-downcast:{acc_dt}<-{val_dt}",
+                               f".at[].{node.func.attr}() of a {val_dt} "
+                               f"value into a {acc_dt} accumulator rounds "
+                               "through the narrow dtype on every update")
+
+    def _check_binop(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Sub) \
+                and self._was_int(node.left) and self._was_int(node.right):
+            self._emit(node, "dequant-before-subtract",
+                       "both operands were dequantized to float before "
+                       "the subtract — histogram subtraction is exact "
+                       "only in int32: subtract first, then convert")
+
+    def run(self) -> None:
+        for node in _walk_local(self.fn):
+            if isinstance(node, ast.Assign):
+                self._record_assign(node)
+        # second pass with the full map (walk order is not source order;
+        # per-function maps are tiny, so two passes beat bookkeeping)
+        for node in _walk_local(self.fn):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, ast.BinOp):
+                self._check_binop(node)
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for qual in sorted(pkg.functions):
+        fi = pkg.functions[qual]
+        _FnChecker(pkg, fi.rel, qual, fi.node, findings).run()
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings

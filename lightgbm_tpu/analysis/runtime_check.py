@@ -15,6 +15,9 @@ tests/test_tpulint.py (and importable for ad-hoc debugging):
   ("disallow")`: any device->host transfer inside the context raises,
   proving a code region is sync-free (or demonstrating a known sync
   site fires, for the positive control).
+- `mesh_axis_check()` — builds the runtime mesh (`build_mesh`) and
+  asserts every runtime axis name is accounted for by the static
+  mesh-axis inventory the collective-axis pack checks against.
 
 jax is imported lazily inside the helpers: the linter core must stay
 importable (and fast) without touching jax at all.
@@ -85,3 +88,35 @@ def static_hot_inventory(pkg: Optional[Package] = None
     if pkg is None:
         pkg = Package.load()
     return sync_points.hot_site_lines(pkg)
+
+
+def mesh_axis_check(config=None, pkg: Optional[Package] = None
+                    ) -> Dict[str, object]:
+    """Compare the meshes the code actually builds against the static
+    mesh-axis inventory (mesh_inventory.axis_inventory).
+
+    Builds the runtime mesh via `treelearner.parallel.build_mesh` for
+    the given `Config` (default config, i.e. all devices on the "data"
+    axis) and reports every runtime axis name the static inventory
+    cannot account for. Empty `unaccounted` = the collective-axis
+    pack's world model matches reality on this topology.
+    """
+    from .mesh_inventory import axis_inventory
+
+    if pkg is None:
+        pkg = Package.load()
+    inv = axis_inventory(pkg)
+
+    from ..config import Config
+    from ..treelearner.parallel import build_mesh
+
+    mesh = build_mesh(config if config is not None else Config())
+    runtime = [str(a) for a in mesh.axis_names]
+    unaccounted = sorted(a for a in runtime if not inv.permits(a))
+    return {
+        "runtime_axes": runtime,
+        "static_axes": sorted(inv.axes),
+        "dynamic": inv.dynamic,
+        "mesh_sites": sorted(inv.meshes),
+        "unaccounted": unaccounted,
+    }

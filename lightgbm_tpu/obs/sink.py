@@ -22,7 +22,11 @@ Iteration record (v1.2):
             and the "hist.quant_bins" gauge under `gauges`; minor 3
             adds the tpulint static-analysis gauges "lint.findings" /
             "lint.baseline_size" under `gauges` and the
-            "hot_loop_syncs" bench summary field),
+            "hot_loop_syncs" bench summary field; minor 4 adds the
+            per-pack meshlint gauges "lint.mesh_findings" /
+            "lint.tile_findings" / "lint.dtype_findings" under
+            `gauges` — collective-axis, kernel-contract, and
+            dtype-flow finding counts),
             phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
             metrics (object: "<dataset>/<metric>" -> number),
@@ -40,8 +44,10 @@ SCHEMA_VERSION = 1
 # additive revision within SCHEMA_VERSION (see module docstring); bumped
 # to 1 when the compile-manager counters/timers joined the record, to 2
 # when the quantized-gradient hist.quant_* counters/gauges joined, to 3
-# when the tpulint lint.* gauges and hot_loop_syncs bench field joined
-SCHEMA_MINOR = 3
+# when the tpulint lint.* gauges and hot_loop_syncs bench field joined,
+# to 4 when the per-pack meshlint lint.{mesh,tile,dtype}_findings
+# gauges joined
+SCHEMA_MINOR = 4
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")

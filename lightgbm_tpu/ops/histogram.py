@@ -127,8 +127,8 @@ def histogram_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((rows_per_block, f), lambda i: (i, 0)),
-            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),
-            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),  # tpulint: tile-ok(grad is a per-row scalar column; [R,1] pads to one lane tile, cheaper than replicating to 128 lanes)
+            pl.BlockSpec((rows_per_block, 1), lambda i: (i, 0)),  # tpulint: tile-ok(hess per-row scalar column, same [R,1] single padded lane tile as grad)
         ],
         out_specs=pl.BlockSpec((2, num_bins, f), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((2, num_bins, f), acc),
@@ -392,7 +392,7 @@ def histogram_radix_pallas(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         grid=(CS, nblk),
         in_specs=[
             pl.BlockSpec((SPf, rows_per_block), lambda s, i: (s, i)),
-            pl.BlockSpec((2, rows_per_block), lambda s, i: (0, i)),
+            pl.BlockSpec((2, rows_per_block), lambda s, i: (0, i)),  # tpulint: tile-ok(gh rides as one [2, R] pair block; sublane 2 pads to 8 once per block, far below the 4x cost of row-major replication)
         ],
         out_specs=pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
                                lambda s, i: (s, 0, 0, 0)),

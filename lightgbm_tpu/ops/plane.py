@@ -161,6 +161,7 @@ def _pack_codes(codes: jax.Array, layout: PlaneLayout,
     elif bits == 8:
         b = codes.astype(jnp.uint8)
     else:
+        # tpulint: tile-ok(deliberate 16b->8b split: each u16 code becomes two little-endian byte planes of the packed-plane layout)
         b = jax.lax.bitcast_convert_type(
             codes.astype(jnp.uint16), jnp.uint8).reshape(n, g * 2)
     width = layout.code_planes * 4

@@ -11,7 +11,9 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   quantized-gradient `hist.quant_*` counters — requantize passes,
   packed collective bytes, overflow escalations — and the
   `hist.quant_bins` gauge; v1.3 adds the tpulint `lint.findings` /
-  `lint.baseline_size` gauges and the `hot_loop_syncs` bench field),
+  `lint.baseline_size` gauges and the `hot_loop_syncs` bench field;
+  v1.4 adds the per-pack meshlint gauges `lint.mesh_findings` /
+  `lint.tile_findings` / `lint.dtype_findings`),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

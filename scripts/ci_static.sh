@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# One-command static gate: ruff (generic Python hygiene) + the full
+# tpulint/meshlint rule set (JAX/TPU invariants), JSON artifact output.
+#
+# Usage:
+#     scripts/ci_static.sh [artifact-dir]
+#
+# Exit 0 = clean. Artifacts: <dir>/tpulint.json (always; the --json
+# payload of all seven rule packs) and the ruff findings on stdout.
+# ruff is optional in the container image: when it is not installed
+# the ruff stage is skipped with a note — tpulint still gates.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+ARTIFACT_DIR="${1:-.}"
+mkdir -p "$ARTIFACT_DIR"
+
+status=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check . || status=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    echo "== ruff (module) =="
+    python -m ruff check . || status=1
+else
+    echo "== ruff: not installed, skipping (tpulint still gates) =="
+fi
+
+echo "== tpulint/meshlint (all rule packs) =="
+if python -m lightgbm_tpu.analysis --json > "$ARTIFACT_DIR/tpulint.json"
+then
+    echo "clean: $ARTIFACT_DIR/tpulint.json"
+else
+    status=1
+    echo "FINDINGS: $ARTIFACT_DIR/tpulint.json"
+    python - "$ARTIFACT_DIR/tpulint.json" <<'EOF'
+import json, sys
+data = json.load(open(sys.argv[1]))
+for f in data["new"]:
+    print(f"  {f['path']}:{f['line']}: {f['rule']}: {f['message']}")
+EOF
+fi
+
+exit "$status"

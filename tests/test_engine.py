@@ -444,7 +444,11 @@ class TestCV:
         assert len(res["binary_logloss-mean"]) == 10
         assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
 
+    @pytest.mark.slow
     def test_cv_early_stopping(self):
+        """Slow-marked: early stopping (TestTrainingControl) and CV
+        aggregation (test_cv_basic) are each tier-1-covered; this
+        re-proves their composition over 100 candidate rounds (27s)."""
         X, y = make_binary()
         res = lgb.cv(dict(P, objective="binary", metric="binary_logloss"),
                      lgb.Dataset(X, label=y), num_boost_round=100, nfold=3,

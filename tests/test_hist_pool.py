@@ -3,6 +3,7 @@ same model as the unlimited pool (reference HistogramPool LRU,
 feature_histogram.hpp:1061 — here the cap switches off subtraction and
 caching instead of evicting)."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -30,7 +31,11 @@ def test_pool_cap_matches_unlimited_fused():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pool_cap_matches_unlimited_serial():
+    """Slow-marked: pool-cap parity is tier-1-covered by the fused
+    variant above; this re-proves it on the host-loop serial grower
+    (7s)."""
     X, y = make_data()
     # interaction constraints force the host-loop serial grower
     # (categoricals used to, but they run fused since round 3)
@@ -47,9 +52,12 @@ def test_pool_cap_matches_unlimited_serial():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pool_cap_matches_unlimited_fused_categorical():
     """Categoricals run the FUSED grower now; the pool-less fallback
-    must still match unlimited-pool training there."""
+    must still match unlimited-pool training there. Slow-marked: the
+    pool-less parity itself is tier-1-covered by the fused and serial
+    variants above; this re-proves it on the categorical path (24s)."""
     X, y = make_data()
     Xc = X.copy()
     Xc[:, 3] = np.random.RandomState(1).randint(0, 5, len(X))

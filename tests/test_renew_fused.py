@@ -48,11 +48,14 @@ def _train_pair(objective, extra=None, weighted=False, rounds=5, seed=3):
 
 @pytest.mark.parametrize("objective,extra,weighted", [
     ("regression_l1", None, False),
-    ("regression_l1", None, True),
+    # the weighted twins only vary the sample weights of an already-
+    # covered objective (test_weights exercises weighting itself);
+    # tier-1 keeps one variant per objective, the full run keeps all
+    pytest.param("regression_l1", None, True, marks=pytest.mark.slow),
     ("quantile", {"alpha": 0.2}, False),
     ("quantile", {"alpha": 0.8}, True),
     ("mape", None, False),
-    ("mape", None, True),
+    pytest.param("mape", None, True, marks=pytest.mark.slow),
 ])
 def test_renew_objective_takes_fused_and_matches_host(objective, extra,
                                                       weighted):
