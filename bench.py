@@ -37,7 +37,11 @@ skip the max_bin=63 sidecar (written to BENCH_BIN63.json next to this
 file when budget allows — same one-line schema, never on stdout),
 BENCH_QUANT=1 to train with quantized gradients
 (use_quantized_grad, docs/QUANTIZED_GRADIENTS.md) at
-BENCH_QUANT_BINS levels (default 64).
+BENCH_QUANT_BINS levels (default 64), BENCH_TRACE=path to record the
+runtime trace timeline (docs/OBSERVABILITY.md) into a
+Perfetto-loadable trace.json — the summary line then reports
+trace_file, and `python -m lightgbm_tpu trace-report <path>` prints
+the phase/sync breakdown.
 
 The summary line additionally reports provenance + latency shape
 (appended after the pre-existing keys, which stay byte-identical):
@@ -76,6 +80,7 @@ REF_EXAMPLE = "/root/reference/examples/binary_classification"
 T0 = time.time()
 QUANT = os.environ.get("BENCH_QUANT", "0") != "0"
 QUANT_BINS = int(os.environ.get("BENCH_QUANT_BINS", 64))
+TRACE = os.environ.get("BENCH_TRACE", "")
 STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "iters_done": 0, "iter_times": [], "test_auc": None,
          "example_auc": None, "predict_us_per_row": None,
@@ -174,6 +179,16 @@ def emit(partial: bool) -> None:
     # the package AST would blow the signal budget
     if STATE["hot_loop_syncs"] is not None:
         out["hot_loop_syncs"] = STATE["hot_loop_syncs"]
+    # runtime trace timeline (schema minor 5)
+    if TRACE:
+        out["trace_file"] = TRACE
+    if REGISTRY is not None:
+        peak = REGISTRY.gauges.get("mem.live_peak_bytes")
+        if peak is not None:
+            out["mem_peak_bytes"] = int(peak)
+        p99 = REGISTRY.coll_p99_ms()
+        if p99 is not None:
+            out["coll_p99_ms"] = round(p99, 3)
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -350,6 +365,11 @@ def main():
     if QUANT:
         params["use_quantized_grad"] = True
         params["num_grad_quant_bins"] = QUANT_BINS
+    if TRACE:
+        # runtime trace timeline of the compile-paying train() window
+        # (the session reuses the module REGISTRY, so mem.*/coll.*
+        # gauges keep accumulating for the summary line)
+        params["trace_file"] = TRACE
     ds = lgb.Dataset(X, label=y)
 
     # first iteration on the SAME booster/shapes pays the compile

@@ -35,15 +35,21 @@ from . import sync_points
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def package_site(skip_analysis: bool = True) -> Optional[Tuple[str, int]]:
+def package_site(skip_analysis: bool = True,
+                 skip_dirs: Tuple[str, ...] = ()
+                 ) -> Optional[Tuple[str, int]]:
     """(repo-relative path, line) of the innermost stack frame inside
-    the package, skipping this analysis subpackage itself."""
-    here = os.path.join(_PKG_DIR, "analysis") + os.sep
+    the package, skipping this analysis subpackage itself plus any
+    subpackage named in `skip_dirs` (the obs tracer passes
+    ("analysis", "obs") so its own sync wrappers never self-attribute)."""
+    skips = tuple(os.path.join(_PKG_DIR, d) + os.sep
+                  for d in (("analysis",) if skip_analysis else ())
+                  + tuple(skip_dirs))
     for frame in reversed(traceback.extract_stack()):
         fn = os.path.abspath(frame.filename)
         if not fn.startswith(_PKG_DIR + os.sep):
             continue
-        if skip_analysis and fn.startswith(here):
+        if fn.startswith(skips):
             continue
         # keys match Package rels: repo-root-relative, e.g.
         # "lightgbm_tpu/boosting/gbdt.py"

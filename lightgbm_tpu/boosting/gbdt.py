@@ -493,8 +493,10 @@ class GBDT:
                 refs[(i, "split_gain")] = (
                     src["split_gain"][t.index] if stacked
                     else src["split_gain"])
-        # tpulint: sync-ok(batched tree stats, ONE transfer per stop check)
-        fetched = jax.device_get(refs) if refs else {}
+        with obs_span("batched tree stats (device fetch)",
+                      phase="stop_check"):
+            # tpulint: sync-ok(batched tree stats, ONE transfer per stop check)
+            fetched = jax.device_get(refs) if refs else {}
         counts, gains = [], []
         for i, t in enumerate(trees):
             if isinstance(t, PendingTree) and t._tree is None:
@@ -555,6 +557,14 @@ class GBDT:
             from ..compile import get_manager
             for k, v in get_manager().snapshot().items():
                 gauges[f"aot_{k}"] = float(v)
+        except Exception:
+            pass
+        # planar per-iteration training state (score planes the update
+        # loop rewrites in place — schema minor 5 mem.* family)
+        try:
+            leaves = jax.tree_util.tree_leaves(self.device_score_state())
+            gauges["mem.planar_state_bytes"] = int(
+                sum(int(getattr(a, "nbytes", 0) or 0) for a in leaves))
         except Exception:
             pass
         from ..obs import active as obs_active
@@ -623,6 +633,11 @@ class GBDT:
         obj = self.objective
         if obj is None or not obj.is_renew_tree_output:
             return
+        with obs_span("renew tree output (leaf refit)", phase="renew"):
+            self._renew_tree_output_impl(tree, class_id)
+
+    def _renew_tree_output_impl(self, tree: Tree, class_id: int) -> None:
+        obj = self.objective
         miss = self.tree_learner.feature_miss_bin
         leaf_idx = np.asarray(tree.leaf_index_binned(
             self.train_data.device_bins(), miss,

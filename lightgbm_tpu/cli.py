@@ -22,10 +22,12 @@ from .utils import log
 
 # GNU-style observability flags accepted alongside the reference's
 # key=value args: --metrics-out FILE / --profile-dir DIR /
-# --metrics-interval K (both `--flag value` and `--flag=value` forms)
+# --trace-out FILE / --metrics-interval K (both `--flag value` and
+# `--flag=value` forms)
 _FLAG_PARAMS = {
     "--metrics-out": "metrics_file",
     "--profile-dir": "profile_dir",
+    "--trace-out": "trace_file",
     "--metrics-interval": "metrics_interval",
     "--conf": "config",
 }
@@ -186,6 +188,10 @@ def run_warmup_task(config: Config, params: Dict[str, str]) -> None:
 
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "trace-report":
+        # pure file-analysis subcommand: no Config, no jax import
+        from .obs.report import main as report_main
+        return report_main(argv[1:])
     params = parse_args(argv)
     config = Config.from_params(params)
     try:

@@ -49,6 +49,7 @@ _IGNORED_CONFIG_FIELDS = frozenset({
     "weight_column", "group_column", "ignore_column", "categorical_feature",
     "two_round", "machines", "machine_list_filename", "time_out",
     "verbosity", "metrics_file", "profile_dir", "metrics_interval",
+    "trace_file", "trace_buffer_events",
     "timetag", "tpu_warmup", "extra", "task", "data_random_seed",
     "metric_freq", "is_provide_training_metric",
     "eval_at", "num_machines", "local_listen_port",

@@ -123,6 +123,8 @@ _ALIASES: Dict[str, str] = {
     "metrics_out": "metrics_file",
     "metrics_output_file": "metrics_file",
     "trace_dir": "profile_dir",
+    "trace_out": "trace_file",
+    "trace_output_file": "trace_file",
     "time_tag": "timetag",
     # dataset
     "max_bins": "max_bin",
@@ -398,6 +400,12 @@ class Config:
     profile_dir: str = ""
     # write every k-th iteration record (1 = all)
     metrics_interval: int = 1
+    # runtime trace timeline (obs/trace.py): Perfetto-loadable
+    # trace.json written at the end of train(); empty = tracing off
+    trace_file: str = ""
+    # tracer ring-buffer capacity in events; the newest events win and
+    # evictions are counted in the export's otherData.dropped_events
+    trace_buffer_events: int = 262144
     # runtime toggle for the utils/timer.py phase table (equivalent to
     # LGBM_TPU_TIMETAG=1, but per-train and without reimport)
     timetag: bool = False

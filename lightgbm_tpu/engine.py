@@ -64,15 +64,18 @@ def _telemetry_end_iteration(telemetry, booster, iteration: int,
     pays this) so the wall time is honest, then attach model stats and
     eval metrics."""
     import jax
+    from . import obs
     gbdt = booster._gbdt
     extra: Dict[str, Any] = {}
     try:
-        # tpulint: sync-ok(telemetry-only stream sync for honest wall time)
-        jax.block_until_ready(gbdt.device_score_state())
+        with obs.span("telemetry stream sync", phase="sync"):
+            # tpulint: sync-ok(telemetry-only stream sync for honest wall time)
+            jax.block_until_ready(gbdt.device_score_state())
     except Exception:
         pass
     try:
-        extra.update(gbdt.telemetry_stats())
+        with obs.span("telemetry stats", phase="telemetry"):
+            extra.update(gbdt.telemetry_stats())
     except Exception as exc:
         log.debug("telemetry_stats failed: %s", exc)
     if evals:
@@ -219,11 +222,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for i in range(num_boost_round):
             if telemetry is not None:
                 telemetry.begin_iteration(i)
-            for cb in callbacks_before:
-                cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                            iteration=i, begin_iteration=0,
-                                            end_iteration=num_boost_round,
-                                            evaluation_result_list=None))
+            with obs.span("before-iteration callbacks", phase="callbacks"):
+                for cb in callbacks_before:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i,
+                                                begin_iteration=0,
+                                                end_iteration=num_boost_round,
+                                                evaluation_result_list=None))
             with obs.span("boosting iteration (device dispatch)",
                           phase="update"):
                 finished = booster.update(fobj=fobj)

@@ -147,7 +147,7 @@ def allgather_bytes(shard_bufs: np.ndarray, mesh=None) -> np.ndarray:
                                check_vma=False))
 
     from ..network import collective_span
-    with collective_span("allgather", int(dev.nbytes)):
+    with collective_span("allgather", int(dev.nbytes), axis="data"):
         return np.asarray(gather(dev))
 
 

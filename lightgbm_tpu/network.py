@@ -30,27 +30,65 @@ from .utils import log
 
 
 @contextlib.contextmanager
-def collective_span(op: str, nbytes: int = 0):
+def collective_span(op: str, nbytes: int = 0, axis: str = ""):
     """Host-side accounting for one collective dispatch (psum /
     all_gather / ...). The ops themselves run inside jitted shard_map
     code where Python cannot observe them, so call sites wrap the
     DISPATCH and pass a computed byte estimate. Records per-op call
     count, bytes, and host-visible latency into the active
-    MetricsRegistry; free when no registry is active.
+    MetricsRegistry (per-axis when `axis` names the mesh axis the op
+    rides) and, when the runtime tracer is on, a "collective" event on
+    the timeline; free when neither is active.
     """
     from .obs import registry as _registry
+    from .obs import trace as _trace
     reg = _registry.active()
-    if reg is None:
+    tr = _trace.active_tracer()
+    if reg is None and tr is None:
         yield
         return
+    tr_t0 = tr.now_ns() if tr is not None else 0
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
-        reg.record_collective(op, nbytes, dt)
+        if reg is not None:
+            reg.record_collective(op, nbytes, dt, axis=axis)
+        if tr is not None:
+            args = {"bytes": int(nbytes)}
+            if axis:
+                args["axis"] = axis
+            tr.complete(op, "collective", tr_t0, tr.now_ns(), args)
         log.trace("collective %s: %d bytes, %.3f ms host", op, nbytes,
                   dt * 1e3)
+
+
+def straggler_skew(seconds: float) -> float:
+    """Cross-host skew gauge for one iteration: every host contributes
+    its wall time, and the gauge is (max - min) / mean over hosts — 0.0
+    means lockstep, 0.3 means the slowest host ran 30%-of-mean longer
+    than the fastest (collectives make everyone wait for it).
+    Single-process runs return 0.0 without touching the interconnect.
+
+    NOTE: this is itself a host barrier (allgather), so it only runs on
+    the metrics/trace path, never in the disabled-telemetry loop.
+    """
+    try:
+        import jax
+        if jax.process_count() <= 1:
+            return 0.0
+        import numpy as np
+        from jax.experimental import multihost_utils
+        times = np.asarray(
+            multihost_utils.process_allgather(np.float32(seconds)),
+            dtype=np.float64).ravel()
+        mean = float(times.mean())
+        if mean <= 0.0:
+            return 0.0
+        return float((times.max() - times.min()) / mean)
+    except Exception:
+        return 0.0
 
 
 def parse_machine_list(machines: str) -> List[str]:

@@ -6,16 +6,20 @@ Layers:
   + per-iteration snapshots; one process-global active registry that
   instrumentation reads with a single `is None` check.
 - `span` / `instrument_kernel` / `step_span` (obs/spans.py): scopes
-  that feed the utils/timer.py table, the registry, and
-  jax.profiler trace annotations at once.
+  that feed the utils/timer.py table, the registry,
+  jax.profiler trace annotations, and the runtime tracer at once.
+- `Tracer` (obs/trace.py): bounded ring buffer of phase/sync/memory/
+  collective events, exported as a Perfetto-loadable trace.json;
+  `obs/report.py` summarizes one (also `python -m lightgbm_tpu
+  trace-report`).
 - `JsonlSink` + schema validators (obs/sink.py).
-- `TelemetrySession` (below): ties registry + sink + profiler to the
-  engine loop, configured from `Config` (`metrics_file`,
-  `profile_dir`, `metrics_interval`).
+- `TelemetrySession` (below): ties registry + sink + profiler + tracer
+  to the engine loop, configured from `Config` (`metrics_file`,
+  `profile_dir`, `trace_file`, `metrics_interval`).
 
-Everything is off by default: with no active registry, no timer, and
-no profile dir, the instrumentation fast paths reduce to a global
-load per call.
+Everything is off by default: with no active registry, no timer, no
+tracer, and no profile dir, the instrumentation fast paths reduce to a
+global load per call.
 """
 from __future__ import annotations
 
@@ -26,6 +30,9 @@ from .sink import (SCHEMA_MINOR, SCHEMA_VERSION, JsonlSink, read_jsonl,
                    validate_bench_record, validate_record)
 from .spans import (instrument_kernel, span, start_profiler, step_span,
                     stop_profiler)
+from .trace import (Tracer, activate_tracer, active_tracer,
+                    deactivate_tracer, install_sync_tracing,
+                    live_array_bytes, uninstall_sync_tracing)
 
 __all__ = [
     "MetricsRegistry", "activate", "active", "deactivate",
@@ -33,67 +40,146 @@ __all__ = [
     "validate_record",
     "validate_bench_record", "span", "step_span", "instrument_kernel",
     "start_profiler", "stop_profiler", "TelemetrySession",
+    "Tracer", "activate_tracer", "active_tracer", "deactivate_tracer",
+    "install_sync_tracing", "uninstall_sync_tracing", "live_array_bytes",
 ]
 
 
 class TelemetrySession:
     """Per-train() telemetry: activates a registry, opens the JSONL
-    sink, optionally starts a jax.profiler trace, and snapshots every
-    iteration. Built by the engine when the Config enables any of it;
-    `from_config` returns None otherwise so the disabled path costs
-    nothing."""
+    sink, optionally starts a jax.profiler trace and/or the runtime
+    tracer, and snapshots every iteration. Built by the engine when the
+    Config enables any of it; `from_config` returns None otherwise so
+    the disabled path costs nothing."""
 
     def __init__(self, metrics_file: str = "", profile_dir: str = "",
                  interval: int = 1,
-                 registry: Optional[MetricsRegistry] = None) -> None:
+                 registry: Optional[MetricsRegistry] = None,
+                 trace_file: str = "",
+                 trace_capacity: int = 262144) -> None:
+        # an already-active registry (bench.py activates one for the
+        # whole process) keeps accumulating — the session must not
+        # shadow it with a fresh one and silently fork the counters
+        if registry is None:
+            registry = active()
         self.registry = registry if registry is not None else MetricsRegistry()
         self.sink = JsonlSink(metrics_file) if metrics_file else None
         self.interval = max(1, int(interval))
         self.profile_dir = profile_dir
+        self.trace_file = trace_file
+        self.tracer = Tracer(trace_capacity) if trace_file else None
         self._step = None
         self._started = False
+        self._prev_registry: Optional[MetricsRegistry] = None
+        self._iter_t0_ns = 0
+        self._mem_peak = 0
 
     @classmethod
     def from_config(cls, cfg: Any) -> Optional["TelemetrySession"]:
         metrics_file = getattr(cfg, "metrics_file", "") or ""
         profile_dir = getattr(cfg, "profile_dir", "") or ""
-        if not metrics_file and not profile_dir:
+        trace_file = getattr(cfg, "trace_file", "") or ""
+        if not metrics_file and not profile_dir and not trace_file:
             return None
         return cls(metrics_file, profile_dir,
-                   getattr(cfg, "metrics_interval", 1))
+                   getattr(cfg, "metrics_interval", 1),
+                   trace_file=trace_file,
+                   trace_capacity=getattr(cfg, "trace_buffer_events",
+                                          262144))
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
         if self._started:
             return
+        self._prev_registry = active()
         activate(self.registry)
         if self.profile_dir:
             start_profiler(self.profile_dir)
+        if self.tracer is not None:
+            activate_tracer(self.tracer)
+            install_sync_tracing()
         self._started = True
 
     def begin_iteration(self, iteration: int) -> None:
         self._exit_step()
         self._step = step_span(iteration)
         self._step.__enter__()
+        if self.tracer is not None:
+            self.tracer.iteration = int(iteration)
+            self._iter_t0_ns = self.tracer.now_ns()
         self.registry.begin_iteration(iteration)
 
     def end_iteration(self, iteration: int,
                       extra: Optional[Dict[str, Any]] = None
                       ) -> Dict[str, Any]:
-        rec = self.registry.end_iteration(extra=extra)
-        self._exit_step()
+        self._sample_environment()
+        try:
+            rec = self.registry.end_iteration(extra=extra)
+        finally:
+            # a raising registry must not leak the open step annotation
+            self._exit_step()
+            if self.tracer is not None:
+                tr = self.tracer
+                tr.complete(f"iteration {iteration}", "iteration",
+                            self._iter_t0_ns, tr.now_ns())
+                tr.iteration = -1
         if self.sink is not None and iteration % self.interval == 0:
             self.sink.write(rec)
         return rec
 
+    def _sample_environment(self) -> None:
+        """Per-iteration device-memory + collective-shape samples
+        (metrics/trace mode only — the disabled path never runs this).
+        Gauges land in the registry (schema minor 5 `mem.*` / `coll.*`)
+        and, when tracing, as counter events on the timeline."""
+        reg = self.registry
+        live = live_array_bytes()
+        if live >= 0:
+            self._mem_peak = max(self._mem_peak, live)
+            reg.set_gauge("mem.live_bytes", live)
+            reg.set_gauge("mem.live_peak_bytes", self._mem_peak)
+            if self.tracer is not None:
+                self.tracer.counter("mem.live_bytes", live, "bytes")
+        p99 = reg.coll_p99_ms()
+        if p99 is not None:
+            reg.set_gauge("coll.p99_ms", round(p99, 3))
+        try:
+            from ..network import straggler_skew
+            if self.tracer is not None:
+                dt_s = (self.tracer.now_ns() - self._iter_t0_ns) / 1e9
+            else:
+                import time as _time
+                dt_s = _time.perf_counter() - reg._iter_t0
+            reg.set_gauge("coll.host_skew", straggler_skew(dt_s))
+        except Exception:
+            pass
+        if self.tracer is not None:
+            reg.counters["trace.events"] = self.tracer.events_total
+            reg.counters["trace.dropped"] = self.tracer.dropped
+
     def close(self) -> None:
         self._exit_step()
-        if self.profile_dir:
-            stop_profiler()
-        if self.sink is not None:
-            self.sink.close()
-        deactivate(self.registry)
-        self._started = False
+        try:
+            if self.tracer is not None:
+                uninstall_sync_tracing()
+                deactivate_tracer(self.tracer)
+                if self.trace_file:
+                    try:
+                        self.tracer.export(self.trace_file)
+                    except OSError as exc:
+                        from ..utils import log
+                        log.warning("trace_file=%s: export failed: %s",
+                                    self.trace_file, exc)
+            if self.profile_dir:
+                stop_profiler()
+        finally:
+            if self.sink is not None:
+                self.sink.close()
+            deactivate(self.registry)
+            if self._prev_registry is not None:
+                activate(self._prev_registry)
+                self._prev_registry = None
+            self._started = False
 
     def _exit_step(self) -> None:
         if self._step is not None:

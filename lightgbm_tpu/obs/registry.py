@@ -25,7 +25,13 @@ so a disabled run pays one `is None` check per instrumented call.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
+
+# retained latency samples per collective op for the p99 estimate; a
+# bounded deque keeps the registry O(1)-memory over arbitrarily long
+# runs (the newest samples are the ones a regression gate cares about)
+_COLL_LAT_SAMPLES = 4096
 
 # phases with first-class snapshot fields; everything else shows up in
 # the snapshot's "phases" map only
@@ -42,6 +48,8 @@ class MetricsRegistry:
         self._iteration: Optional[int] = None
         self._iter_t0 = 0.0
         self._times_at_begin: Dict[str, float] = {}
+        # op -> bounded deque of host-latency seconds (schema minor 5)
+        self._coll_lat: Dict[str, deque] = {}
 
     # -- accumulation ---------------------------------------------------
     def inc(self, name: str, value: float = 1) -> None:
@@ -63,13 +71,37 @@ class MetricsRegistry:
     def add_time(self, phase: str, seconds: float) -> None:
         self.times[phase] = self.times.get(phase, 0.0) + seconds
 
-    def record_collective(self, op: str, nbytes: int, seconds: float) -> None:
+    def record_collective(self, op: str, nbytes: int, seconds: float,
+                          axis: str = "") -> None:
         """One collective dispatch: call count, payload bytes (computed
         host-side — the op itself runs inside jitted code), host
-        latency."""
+        latency. `axis` is the mesh axis the op rides (schema minor 5:
+        per-axis byte accounting + per-op latency histograms)."""
         self.inc(f"collective.{op}.calls")
         self.inc(f"collective.{op}.bytes", int(nbytes))
         self.add_time(f"collective.{op}", seconds)
+        # per-iteration latency histogram (snapshots into "hists") +
+        # bounded cumulative sample set for the session p99
+        self.observe(f"coll.{op}.ms", seconds * 1e3)
+        lat = self._coll_lat.get(op)
+        if lat is None:
+            lat = self._coll_lat[op] = deque(maxlen=_COLL_LAT_SAMPLES)
+        lat.append(seconds)
+        if axis:
+            self.inc(f"coll.axis.{axis}.calls")
+            self.inc(f"coll.axis.{axis}.bytes", int(nbytes))
+
+    def coll_p99_ms(self) -> Optional[float]:
+        """p99 host latency (ms) over the retained samples of ALL
+        collective ops; None when no collective ran."""
+        samples: List[float] = []
+        for lat in self._coll_lat.values():
+            samples.extend(lat)
+        if not samples:
+            return None
+        samples.sort()
+        idx = min(len(samples) - 1, int(0.99 * (len(samples) - 1) + 0.5))
+        return samples[idx] * 1e3
 
     # -- iteration lifecycle --------------------------------------------
     def begin_iteration(self, iteration: int,
@@ -136,7 +168,7 @@ class MetricsRegistry:
                 out[f"phase_{ph}_s"] = round(self.times[ph], 3)
         for key in sorted(self.counters):
             if key.startswith(("collective.", "kernel.", "compile.",
-                               "eval.", "hist.")):
+                               "eval.", "hist.", "coll.", "trace.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out
@@ -146,6 +178,7 @@ class MetricsRegistry:
         self.gauges.clear()
         self.times.clear()
         self._hist.clear()
+        self._coll_lat.clear()
         self.last_record = None
         self._iteration = None
 

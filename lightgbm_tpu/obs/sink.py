@@ -26,7 +26,16 @@ Iteration record (v1.2):
             per-pack meshlint gauges "lint.mesh_findings" /
             "lint.tile_findings" / "lint.dtype_findings" under
             `gauges` — collective-axis, kernel-contract, and
-            dtype-flow finding counts),
+            dtype-flow finding counts; minor 5 adds the runtime trace
+            timeline fields (obs/trace.py): "trace.*" ring-buffer
+            counters under `counters` — trace.events / trace.dropped —
+            "mem.*" gauges under `gauges` — mem.live_bytes /
+            mem.live_peak_bytes live-array HBM samples and
+            mem.planar_state_bytes planar-state estimate — per-op
+            "coll.{op}.ms" latency entries under `hists`, per-axis
+            "coll.axis.*" counters, and the "coll.host_skew" /
+            "coll.p99_ms" gauges, plus the trace_file /
+            mem_peak_bytes / coll_p99_ms bench summary fields),
             phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
             metrics (object: "<dataset>/<metric>" -> number),
@@ -46,8 +55,9 @@ SCHEMA_VERSION = 1
 # when the quantized-gradient hist.quant_* counters/gauges joined, to 3
 # when the tpulint lint.* gauges and hot_loop_syncs bench field joined,
 # to 4 when the per-pack meshlint lint.{mesh,tile,dtype}_findings
-# gauges joined
-SCHEMA_MINOR = 4
+# gauges joined, to 5 when the runtime trace timeline fields joined
+# (trace.* counters, mem.* gauges, coll.* latency/axis accounting)
+SCHEMA_MINOR = 5
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -63,9 +73,12 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "quantized", "num_grad_quant_bins",
                        "iter_p50_s", "iter_p90_s", "hist_share",
                        # static hot-loop sync inventory (schema minor 3)
-                       "hot_loop_syncs")
-# optional string-typed bench keys (minor 2): histogram kernel variant
-_BENCH_OPTIONAL_STR = ("hist_method",)
+                       "hot_loop_syncs",
+                       # runtime trace timeline (schema minor 5)
+                       "mem_peak_bytes", "coll_p99_ms")
+# optional string-typed bench keys (minor 2): histogram kernel variant;
+# (minor 5): runtime trace output path
+_BENCH_OPTIONAL_STR = ("hist_method", "trace_file")
 
 
 def _num_map_problems(rec: Dict[str, Any], key: str,

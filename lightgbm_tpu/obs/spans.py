@@ -1,12 +1,21 @@
-"""span(): one scope, three consumers.
+"""span(): one scope, four consumers.
 
 A `span` feeds (a) the `utils/timer.py` global table — same names, so
 the LGBM_TPU_TIMETAG phase table is unchanged, (b) the active
-`MetricsRegistry` phase times when a `phase=` is given, and (c) a
+`MetricsRegistry` phase times when a `phase=` is given, (c) a
 `jax.profiler.TraceAnnotation` range, so host scopes line up with
-device traces in XProf when `profile_dir` is set. When neither the
-timer nor a registry is enabled, a span is a bare `yield` — no
-annotation, no clock read.
+device traces in XProf when `profile_dir` is set, and (d) a complete
+event in the active runtime `Tracer` (obs/trace.py), so the Perfetto
+timeline shows every instrumented scope in order. When none of the
+consumers is enabled, a span is a bare `yield` — no annotation, no
+clock read.
+
+Exception safety: the consumer writes in the finally block run inside
+their own try/finally chain, so a raising consumer (or a raising body)
+can never leak an open profiler annotation or corrupt the timeline —
+the annotation ALWAYS closes, and a tracer event is only appended as a
+fully-formed [t0, t1] tuple. Spans nest re-entrantly: all pairing
+state lives in the generator's locals.
 
 `instrument_kernel` wraps a jitted callable once (at lru-cache build
 time) so every dispatch call site is timed without editing each call;
@@ -20,6 +29,7 @@ from typing import Optional, Tuple
 
 from ..utils import timer as _timer
 from . import registry as _registry
+from . import trace as _trace
 
 
 def _trace_annotation(name: str):
@@ -36,22 +46,32 @@ def _trace_annotation(name: str):
 def span(name: str, phase: Optional[str] = None):
     reg = _registry.active()
     gt = _timer.global_timer
-    if reg is None and not gt.enabled:
+    tr = _trace.active_tracer()
+    if reg is None and not gt.enabled and tr is None:
         yield
         return
     ann = _trace_annotation(name)
+    tr_t0 = tr.now_ns() if tr is not None else 0
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
-        if gt.enabled:
-            gt.acc[name] += dt
-            gt.cnt[name] += 1
-        if reg is not None and phase is not None:
-            reg.add_time(phase, dt)
-        if ann is not None:
-            ann.__exit__(None, None, None)
+        # the annotation must close even when a consumer write raises
+        try:
+            dt = time.perf_counter() - t0
+            try:
+                if gt.enabled:
+                    gt.acc[name] += dt
+                    gt.cnt[name] += 1
+                if reg is not None and phase is not None:
+                    reg.add_time(phase, dt)
+            finally:
+                if tr is not None:
+                    tr.complete(name, "phase", tr_t0, tr.now_ns(),
+                                {"phase": phase} if phase else None)
+        finally:
+            if ann is not None:
+                ann.__exit__(None, None, None)
 
 
 @contextlib.contextmanager
@@ -75,27 +95,41 @@ def step_span(iteration: int):
 
 
 def instrument_kernel(fn, phase: str, name: Optional[str] = None,
-                      collective: Optional[Tuple[str, int]] = None):
+                      collective: Optional[Tuple] = None):
     """Wrap a (jitted) callable with per-call phase timing + a call
     counter, and optionally collective accounting (`collective` is
-    (op_name, payload_bytes_per_call) — bytes are computed at wrap
-    time because the op runs inside traced code). Timing is host-side
-    dispatch latency: under async dispatch it covers enqueue, on the
-    synchronous test path it covers the compute too."""
+    (op_name, payload_bytes_per_call[, mesh_axis]) — bytes are computed
+    at wrap time because the op runs inside traced code). Timing is
+    host-side dispatch latency: under async dispatch it covers enqueue,
+    on the synchronous test path it covers the compute too."""
     label = name or f"kernel/{phase}"
+    if collective is not None:
+        coll_op, coll_bytes = collective[0], int(collective[1])
+        coll_axis = collective[2] if len(collective) > 2 else ""
 
     def wrapper(*args, **kwargs):
         reg = _registry.active()
-        if reg is None and not _timer.global_timer.enabled:
+        tr = _trace.active_tracer()
+        if reg is None and not _timer.global_timer.enabled \
+                and tr is None:
             return fn(*args, **kwargs)
+        tr_t0 = tr.now_ns() if tr is not None else 0
+        t0 = time.perf_counter()
         with span(label, phase=phase):
             out = fn(*args, **kwargs)
         if reg is not None:
             reg.inc(f"kernel.{phase}.calls")
             if collective is not None:
-                op, nbytes = collective
-                reg.inc(f"collective.{op}.calls")
-                reg.inc(f"collective.{op}.bytes", int(nbytes))
+                # full collective accounting (latency histogram, axis
+                # counters) — same path network.collective_span takes
+                reg.record_collective(coll_op, coll_bytes,
+                                      time.perf_counter() - t0,
+                                      axis=coll_axis)
+        if tr is not None and collective is not None:
+            args_d = {"bytes": coll_bytes}
+            if coll_axis:
+                args_d["axis"] = coll_axis
+            tr.complete(coll_op, "collective", tr_t0, tr.now_ns(), args_d)
         return out
 
     wrapper.__name__ = getattr(fn, "__name__", label)

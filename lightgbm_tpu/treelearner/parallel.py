@@ -184,7 +184,7 @@ class DataParallelTreeGrower(SerialTreeGrower):
                 f"data_parallel/leaf_histogram_c{capacity}"
                 + ("_packed" if packed else ""), fn),
             "hist", name="data_parallel/leaf_histogram",
-            collective=("hist_psum", psum_bytes))
+            collective=("hist_psum", psum_bytes, "data"))
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn_sharded(self, capacity: int):
@@ -611,7 +611,8 @@ class VotingParallelTreeGrower(DataParallelTreeGrower):
             "hist", name="voting_parallel/leaf_histogram",
             collective=("voting_psum",
                         self.num_features * 4
-                        + k2_est * B * (1 if packed else 2) * 4))
+                        + k2_est * B * (1 if packed else 2) * 4,
+                        "data"))
 
 
 class FeatureParallelTreeGrower(SerialTreeGrower):
@@ -758,7 +759,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 jnp.float32(bias))
         if quant:
             args = args + (self._next_quant_keys(1)[0],)
-        with collective_span("fused_iter_psum", self._tree_psum_bytes):
+        with collective_span("fused_iter_psum", self._tree_psum_bytes,
+                             axis="data"):
             return self._iter_mc_jit(*args)
 
     def train_iters_persistent(self, data, shrinkage, masks):
@@ -800,7 +802,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
         args = (data, self._n_per_shard, masks, jnp.float32(shrinkage))
         if quant:
             args = args + (self._next_quant_keys(k),)
-        with collective_span("fused_iter_psum", k * self._tree_psum_bytes):
+        with collective_span("fused_iter_psum",
+                             k * self._tree_psum_bytes, axis="data"):
             return self._iters_mc_jit_k[k](*args)
 
     def _sync_scores(self, data):
@@ -815,7 +818,7 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 score, mode="drop", unique_indices=True)
             return jax.lax.psum(out, "data")
 
-        with collective_span("scores_psum", n * 4):
+        with collective_span("scores_psum", n * 4, axis="data"):
             return functools.partial(
                 shard_map, mesh=self.mesh, check_vma=False,
                 in_specs=(P(None, "data"),), out_specs=P())(body)(data)
@@ -904,7 +907,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
 
         if self._grow_mc_tree_jit is None:
             self._grow_mc_tree_jit = self._grow_mc_jit_build()
-        with collective_span("fused_tree_psum", self._tree_psum_bytes):
+        with collective_span("fused_tree_psum", self._tree_psum_bytes,
+                             axis="data"):
             ta, leaf = self._grow_mc_tree_jit(
                 self._bins_row_sharded(), perm_dev, counts_dev,
                 pad_rows(grad), pad_rows(hess),

@@ -34,7 +34,7 @@ from ..models.tree import Tree
 from ..ops import histogram as H
 from ..ops import quantize as Q
 from ..ops import split as S
-from ..obs import instrument_kernel
+from ..obs import instrument_kernel, span as obs_span
 from ..ops.partition import next_capacity, partition_leaf
 from ..utils import log
 
@@ -324,15 +324,16 @@ class SerialTreeGrower:
         if self._quant:
             # one quantization pass per tree; histograms, the pool, and
             # subtraction then run in exact int32 level space
-            Q.note_requantize(cfg.num_grad_quant_bins)
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
-                self._quant_tree_idx)
-            self._quant_tree_idx += 1
-            grad, hess, gs, hs = Q.quantize_gradients(
-                grad, hess, cfg.num_grad_quant_bins, key,
-                cfg.stochastic_rounding)
-            self._qscales = (gs, hs)
+            with obs_span("gradient quantization", phase="quantize"):
+                Q.note_requantize(cfg.num_grad_quant_bins)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
+                    self._quant_tree_idx)
+                self._quant_tree_idx += 1
+                grad, hess, gs, hs = Q.quantize_gradients(
+                    grad, hess, cfg.num_grad_quant_bins, key,
+                    cfg.stochastic_rounding)
+                self._qscales = (gs, hs)
 
         self._cur_perm, self._cur_grad, self._cur_hess = perm, grad, hess
         root = _Leaf(0, num_data, 0.0, 0.0, 0.0, 0)

@@ -13,7 +13,12 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   `hist.quant_bins` gauge; v1.3 adds the tpulint `lint.findings` /
   `lint.baseline_size` gauges and the `hot_loop_syncs` bench field;
   v1.4 adds the per-pack meshlint gauges `lint.mesh_findings` /
-  `lint.tile_findings` / `lint.dtype_findings`),
+  `lint.tile_findings` / `lint.dtype_findings`; v1.5 adds the runtime
+  trace timeline fields — `trace.*` ring-buffer counters, `mem.*`
+  live-array/planar-state gauges, per-op `coll.{op}.ms` latency
+  histograms, per-axis `coll.axis.*` counters, the `coll.host_skew` /
+  `coll.p99_ms` gauges, and the `trace_file` / `mem_peak_bytes` /
+  `coll_p99_ms` bench summary fields),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Perf-regression gate: fresh bench JSON vs the latest BENCH_r*.json.
+
+Compares the lower-is-better latency keys of a fresh bench.py summary
+line (raw line, a file holding one, or a driver artifact with the line
+under "parsed") against the most recent BENCH_r*.json in the repo root,
+and exits non-zero when any key regressed beyond the tolerance:
+
+    fresh > baseline * (1 + tol)     ->  REGRESSION
+
+Keys checked (only those present on BOTH sides — a run that skipped
+prediction can't regress predict latency):
+
+- value            (train wall-clock seconds, the headline number)
+- iter_p50_s       (steady-state per-iteration latency)
+- predict_us_per_row
+
+Usage:
+    python scripts/check_perf_regress.py FRESH.json [--tol 0.10]
+        [--baseline BENCH_rNN.json]
+
+Wired into scripts/ci_static.sh behind PERF_REGRESS_BENCH=FRESH.json
+(opt-in: the static lane has no TPU to produce a fresh bench line).
+Partial baseline runs still gate: their extrapolated value is the best
+available estimate, and a 10% default tolerance absorbs the noise.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# lower-is-better keys the gate compares
+PERF_KEYS = ("value", "iter_p50_s", "predict_us_per_row")
+
+
+def unwrap(doc: Any) -> Optional[Dict[str, Any]]:
+    """The bench summary dict inside `doc` (handles the driver's
+    {"parsed": ...} wrapper), or None when there is none."""
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc["parsed"]
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    return None
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    rec = unwrap(doc)
+    if rec is None:
+        raise ValueError(f"{path}: not a bench summary "
+                         "(no 'metric' key, no 'parsed' wrapper)")
+    return rec
+
+
+def latest_baseline(repo: str = REPO) -> Optional[str]:
+    """Most recent BENCH_r*.json by round number (lexicographic works:
+    the driver zero-pads), skipping artifacts with no parsed line."""
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            if unwrap(json.load(open(path))) is not None:
+                return path
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def compare(fresh: Dict[str, Any], base: Dict[str, Any],
+            tol: float) -> Tuple[list, list]:
+    """(regressions, report_lines) over the shared PERF_KEYS."""
+    regressions, lines = [], []
+    for key in PERF_KEYS:
+        f, b = fresh.get(key), base.get(key)
+        if not isinstance(f, (int, float)) or isinstance(f, bool) or \
+                not isinstance(b, (int, float)) or isinstance(b, bool):
+            lines.append(f"  {key:<20} skipped (missing on one side)")
+            continue
+        if b <= 0 or f <= 0:
+            lines.append(f"  {key:<20} skipped (non-positive sample)")
+            continue
+        ratio = f / b
+        verdict = "REGRESSION" if ratio > 1.0 + tol else "ok"
+        lines.append(f"  {key:<20} {b:>12.4g} -> {f:>12.4g}  "
+                     f"({ratio:+.1%} of baseline)  {verdict}")
+        if verdict == "REGRESSION":
+            regressions.append((key, b, f, ratio))
+    return regressions, lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("fresh", help="fresh bench summary JSON")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: latest BENCH_r*.json)")
+    parser.add_argument("--tol", type=float, default=0.10,
+                        help="allowed fractional slowdown (default 0.10)")
+    ns = parser.parse_args(argv)
+
+    try:
+        fresh = load_bench(ns.fresh)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf-regress: cannot read fresh bench: {exc}")
+        return 2
+    base_path = ns.baseline or latest_baseline()
+    if base_path is None:
+        print("perf-regress: no BENCH_r*.json baseline found — "
+              "nothing to gate against (pass)")
+        return 0
+    try:
+        base = load_bench(base_path)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf-regress: cannot read baseline: {exc}")
+        return 2
+
+    regressions, lines = compare(fresh, base, ns.tol)
+    print(f"perf-regress: {ns.fresh} vs {os.path.basename(base_path)} "
+          f"(tol {ns.tol:.0%})")
+    print("\n".join(lines))
+    if regressions:
+        worst = max(regressions, key=lambda r: r[3])
+        print(f"perf-regress: FAIL — {len(regressions)} key(s) "
+              f"regressed; worst: {worst[0]} "
+              f"{worst[1]:.4g} -> {worst[2]:.4g}")
+        return 1
+    print("perf-regress: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

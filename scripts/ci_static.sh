@@ -42,4 +42,14 @@ for f in data["new"]:
 EOF
 fi
 
+# optional perf-regression gate: set PERF_REGRESS_BENCH to a fresh
+# bench.py summary JSON to compare it against the latest BENCH_r*.json
+# (the static lane has no TPU, so this only runs when a bench result is
+# handed in; PERF_REGRESS_TOL overrides the 10% default tolerance)
+if [ -n "${PERF_REGRESS_BENCH:-}" ]; then
+    echo "== perf-regress gate =="
+    python scripts/check_perf_regress.py "$PERF_REGRESS_BENCH" \
+        --tol "${PERF_REGRESS_TOL:-0.10}" || status=1
+fi
+
 exit "$status"

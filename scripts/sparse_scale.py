@@ -119,11 +119,14 @@ def main():
     auc = 1.0 - (np.sum(np.arange(1, len(yy) + 1)[yy])
                  - pos * (pos + 1) / 2) / (pos * neg)
 
-    # deterministic device-footprint accounting of the TRAINING loop
-    # (memory_stats is not exposed through the accelerator tunnel).
+    # deterministic device-footprint accounting of the TRAINING loop,
+    # cross-checked below against the obs layer's live-array sampler
+    # (obs.live_array_bytes — the shared portable HBM estimator).
     # The row-major traverse bins stay HOST-side: the grower's lazy
     # property (round-5 fix) never uploads them on the persistent path,
     # and prediction uses the raw-feature path forest
+    from lightgbm_tpu.obs import live_array_bytes
+    live_measured = live_array_bytes()
     acct = {}
     if layout is not None:
         acct["planar state [P,R] i32"] = layout.num_planes * layout.num_lanes * 4
@@ -170,6 +173,9 @@ def main():
         f"- **total: {total / 1e9:.2f} GB** of 16 GB HBM "
         "(naive dense u8 would be "
         f"{ROWS * VARS * CATS / 1e9:.1f} GB — does not fit)",
+        (f"- measured live-array bytes (obs.live_array_bytes): "
+         f"{live_measured / 1e9:.2f} GB" if live_measured >= 0 else
+         "- measured live-array bytes: unavailable (no jax)"),
         "",
         f"Generated by scripts/sparse_scale.py; total wall "
         f"{time.time() - T0:.0f}s.",
