@@ -36,6 +36,32 @@ K_EPSILON = 1e-15
 K_MODEL_VERSION = "v3"
 
 
+def parse_tree_blocks(text: str) -> List[Tree]:
+    """The Tree= blocks of a model text as host Trees (shared by
+    load_model_from_string and checkpoint resume — resume rebuilds the
+    forest from the checkpointed model text instead of re-predicting,
+    because Tree text round-trips bit-exactly via repr())."""
+    body = text[text.index("tree_sizes="):]
+    out = []
+    for blk in body.split("Tree=")[1:]:
+        blk = blk.split("end of trees")[0]
+        out.append(Tree.from_string(blk.partition("\n")[2]))
+    return out
+
+
+def _pack_rng(rng: np.random.RandomState) -> dict:
+    kind, keys, pos, has_gauss, cached = rng.get_state()
+    return {"kind": kind, "keys": np.asarray(keys, dtype=np.uint32),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def _unpack_rng(rng: np.random.RandomState, state: dict) -> None:
+    rng.set_state((state["kind"], np.asarray(state["keys"], np.uint32),
+                   int(state["pos"]), int(state["has_gauss"]),
+                   float(state["cached"])))
+
+
 class _ScoreState:
     """Per-dataset score accumulator (reference score_updater.hpp:21)."""
 
@@ -985,18 +1011,119 @@ class GBDT:
                 self.objective = create_objective(cfg)
             except BaseException:
                 self.objective = None
-        self.models = []
-        body = text[text.index("tree_sizes="):]
-        trees = body.split("Tree=")[1:]
-        for blk in trees:
-            blk = blk.split("end of trees")[0]
-            self.models.append(Tree.from_string(blk.partition("\n")[2]))
+        self.models = list(parse_tree_blocks(text))
         self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
         self.num_init_iteration = self.iter
         pstart = text.find("\nparameters:")
         if pstart >= 0:
             self.loaded_parameter = text[pstart + len("\nparameters:"):]\
                 .split("end of parameters")[0].strip()
+
+    # ------------------------------------------------------------------
+    # checkpoint/resume (robust/checkpoint.py, docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    def checkpoint_state(self) -> Dict:
+        """Loop state beyond the model text that an interrupted run
+        needs to continue bit-identically: every host RNG stream, the
+        bagging permutation, and the f32 score accumulators (restored
+        directly — recomputing scores from the trees would change the
+        accumulation order and drift in the last ulp)."""
+        self._flush_persistent_queue()
+        self._materialize_models()
+        st: Dict = {
+            "iter": int(self.iter),
+            "num_init_iteration": int(self.num_init_iteration),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "class_need_train": [bool(v) for v in self.class_need_train],
+            "bag_data_cnt": int(self.bag_data_cnt),
+            "bag_rng": _pack_rng(self._bag_rng),
+            "best_iter": int(self.best_iter),
+        }
+        if self._perm is not self._full_perm:
+            st["perm"] = np.asarray(self._perm)
+        tl = self.tree_learner
+        if getattr(tl, "_col_rng", None) is not None:
+            st["tl_col_rng"] = _pack_rng(tl._col_rng)
+        if getattr(tl, "_extra_rng", None) is not None:
+            st["tl_extra_rng"] = _pack_rng(tl._extra_rng)
+        if self._fused is not None:
+            if getattr(self._fused, "_col_rng", None) is not None:
+                st["fused_col_rng"] = _pack_rng(self._fused._col_rng)
+            st["quant_iter"] = int(getattr(self._fused, "_quant_iter", 0))
+        if self._fused_state is not None \
+                and hasattr(self._fused, "persistent_lane_state"):
+            # lane order is part of the numeric state: histogram and
+            # score accumulation follow it, so save the permuted planes
+            # (rowid + score bits) instead of row-order scores
+            rowid, score_bits = self._fused.persistent_lane_state(
+                self._fused_state)
+            st["fused_lane_rowid"] = rowid
+            st["fused_lane_score"] = score_bits
+        else:
+            st["train_score"] = np.asarray(self.get_training_score())
+        st["valid_scores"] = [np.asarray(vs.score) for vs in self.valid_score]
+        return st
+
+    def restore_checkpoint_state(self, state: Dict, model_text: str) -> None:
+        """Inverse of checkpoint_state against a freshly-initialized
+        booster on the same dataset/config."""
+        self._pred_revision = getattr(self, "_pred_revision", 0) + 1
+        self.models = list(parse_tree_blocks(model_text))
+        # the text format drops bin-space fields; train-time score
+        # surgery (DART drop/normalize, rollback) traverses in bin
+        # space, so every restored tree must re-link to the dataset
+        for t in self.models:
+            t.relink_to_dataset(self.train_data)
+        self.iter = int(state["iter"])
+        self.num_init_iteration = int(state.get("num_init_iteration", 0))
+        self.shrinkage_rate = float(
+            state.get("shrinkage_rate", self.shrinkage_rate))
+        if "class_need_train" in state:
+            self.class_need_train = [bool(v)
+                                     for v in state["class_need_train"]]
+        self.bag_data_cnt = int(state.get("bag_data_cnt", self.num_data))
+        if "bag_rng" in state:
+            _unpack_rng(self._bag_rng, state["bag_rng"])
+        if "perm" in state:
+            self._perm = jnp.asarray(np.asarray(state["perm"], np.int32))
+        self.best_iter = int(state.get("best_iter", 0))
+        tl = self.tree_learner
+        if "tl_col_rng" in state and getattr(tl, "_col_rng", None) is not None:
+            _unpack_rng(tl._col_rng, state["tl_col_rng"])
+        if "tl_extra_rng" in state \
+                and getattr(tl, "_extra_rng", None) is not None:
+            _unpack_rng(tl._extra_rng, state["tl_extra_rng"])
+        if self._fused is not None:
+            if "fused_col_rng" in state \
+                    and getattr(self._fused, "_col_rng", None) is not None:
+                _unpack_rng(self._fused._col_rng, state["fused_col_rng"])
+            if hasattr(self._fused, "_quant_iter"):
+                self._fused._quant_iter = int(state.get("quant_iter", 0))
+        if "fused_lane_rowid" in state:
+            if self._fused is None \
+                    or not hasattr(self._fused, "restore_persistent_state"):
+                log.fatal(
+                    "Checkpoint holds fused persistent-path state but the "
+                    "current configuration selected a different tree grower; "
+                    "refusing to resume (delete the checkpoint directory or "
+                    "restore the original parameters)")
+            self._fused_state = self._fused.restore_persistent_state(
+                state["fused_lane_rowid"], state["fused_lane_score"])
+            self._score_dirty = True
+        elif "train_score" in state:
+            self.train_score.score = jnp.asarray(
+                np.asarray(state["train_score"], np.float32))
+            self._fused_state = None
+            self._score_dirty = False
+        vs_arrays = state.get("valid_scores", [])
+        if len(vs_arrays) != len(self.valid_score):
+            log.warning(
+                "Checkpoint has %d valid-set score arrays but the resumed "
+                "train() call wired %d valid sets; resumed eval metrics may "
+                "not match the uninterrupted run",
+                len(vs_arrays), len(self.valid_score))
+        for vs, arr in zip(self.valid_score, vs_arrays):
+            vs.score = jnp.asarray(np.asarray(arr, np.float32))
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: int = 0,
@@ -1061,6 +1188,22 @@ class DART(GBDT):
         self.sum_weight = 0.0
         self.drop_index: List[int] = []
         self.shrinkage_rate = config.learning_rate
+
+    def checkpoint_state(self) -> Dict:
+        st = super().checkpoint_state()
+        st["dart"] = {"drop_rng": _pack_rng(self._drop_rng),
+                      "tree_weight": [float(w) for w in self.tree_weight],
+                      "sum_weight": float(self.sum_weight)}
+        return st
+
+    def restore_checkpoint_state(self, state: Dict, model_text: str) -> None:
+        super().restore_checkpoint_state(state, model_text)
+        d = state.get("dart")
+        if d:
+            _unpack_rng(self._drop_rng, d["drop_rng"])
+            self.tree_weight = [float(w) for w in d["tree_weight"]]
+            self.sum_weight = float(d["sum_weight"])
+            self.drop_index = []
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is None or hessians is None:

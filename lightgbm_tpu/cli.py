@@ -30,6 +30,9 @@ _FLAG_PARAMS = {
     "--trace-out": "trace_file",
     "--metrics-interval": "metrics_interval",
     "--conf": "config",
+    # preemption-safe training (docs/ROBUSTNESS.md)
+    "--checkpoint-dir": "checkpoint_dir",
+    "--checkpoint-interval": "checkpoint_interval",
 }
 
 # bare subcommand words accepted as the first argument:

@@ -53,6 +53,10 @@ _IGNORED_CONFIG_FIELDS = frozenset({
     "timetag", "tpu_warmup", "extra", "task", "data_random_seed",
     "metric_freq", "is_provide_training_metric",
     "eval_at", "num_machines", "local_listen_port",
+    # fault tolerance: where/how often checkpoints land never changes
+    # any traced program — resuming with a different checkpoint_dir
+    # must hit the same executables
+    "checkpoint_dir", "checkpoint_interval", "checkpoint_keep",
 })
 
 

@@ -82,7 +82,10 @@ class ExecutableStore:
         path = self.path(key)
         try:
             with open(path, "rb") as fh:
-                payload = pickle.load(fh)
+                raw = fh.read()
+            from ..robust.faultinject import filter_bytes
+            raw = filter_bytes("store.load", raw)
+            payload = pickle.loads(raw)
             if (not isinstance(payload, dict)
                     or payload.get("v") != _PAYLOAD_VERSION
                     or payload.get("jax") != jax.__version__):
@@ -90,6 +93,15 @@ class ExecutableStore:
             return payload["blob"], payload["in_tree"], payload["out_tree"]
         except FileNotFoundError:
             return None
+        except (EOFError, pickle.UnpicklingError) as exc:
+            # a crash mid-save (or a torn copy) leaves a short pickle:
+            # same recovery as any other corruption, but named so the
+            # fallback is visibly about truncation, not version drift
+            log.debug("AOT store: dropping truncated/corrupt pickle %s (%s)",
+                      path, exc)
+            self.invalidate(key)
+            raise CorruptBlobError(
+                f"truncated or corrupt pickle: {exc}") from exc
         except Exception as exc:
             log.debug("AOT store: dropping corrupt blob %s (%s)", path, exc)
             self.invalidate(key)

@@ -126,6 +126,12 @@ _ALIASES: Dict[str, str] = {
     "trace_out": "trace_file",
     "trace_output_file": "trace_file",
     "time_tag": "timetag",
+    # fault tolerance
+    "checkpoint_path": "checkpoint_dir",
+    "ckpt_dir": "checkpoint_dir",
+    "checkpoint_freq": "checkpoint_interval",
+    "ckpt_interval": "checkpoint_interval",
+    "ckpt_keep": "checkpoint_keep",
     # dataset
     "max_bins": "max_bin",
     "subsample_for_bin": "bin_construct_sample_cnt",
@@ -413,6 +419,15 @@ class Config:
     # (docs/COMPILE_CACHE.md); LGBM_TPU_WARMUP overrides both ways
     tpu_warmup: bool = False
 
+    # --- fault tolerance (docs/ROBUSTNESS.md) ---
+    # directory for periodic atomic training checkpoints; train()
+    # auto-resumes from the latest valid one. Empty = off.
+    checkpoint_dir: str = ""
+    # write a checkpoint every k-th completed boosting iteration
+    checkpoint_interval: int = 50
+    # retain the newest k checkpoint files
+    checkpoint_keep: int = 2
+
     # --- dataset ---
     max_bin: int = 255
     max_bin_by_feature: List[int] = field(default_factory=list)
@@ -637,14 +652,23 @@ class Config:
         self.num_leaves = max(self.num_leaves, 2)
         self.max_bin = max(self.max_bin, 2)
         self.metrics_interval = max(self.metrics_interval, 1)
+        if self.checkpoint_dir:
+            self.checkpoint_interval = max(self.checkpoint_interval, 1)
+            self.checkpoint_keep = max(self.checkpoint_keep, 1)
         log.set_verbosity(self.verbosity)
 
     def to_params_string(self) -> str:
         """Serialize `key: value` lines for the saved-model parameters block
         (reference gbdt_model_text.cpp SaveModelToString tail)."""
         out = []
+        # checkpoint fields stay OUT of the parameters block: a resumed
+        # run and its uninterrupted baseline must serialize identical
+        # model texts (the chaos tests compare them byte-for-byte), and
+        # where the checkpoint lives is operational, not model, state
+        skip = ("extra", "checkpoint_dir", "checkpoint_interval",
+                "checkpoint_keep")
         for f in dataclasses.fields(self):
-            if f.name == "extra":
+            if f.name in skip:
                 continue
             v = getattr(self, f.name)
             if isinstance(v, list):

@@ -84,14 +84,51 @@ def _telemetry_end_iteration(telemetry, booster, iteration: int,
     telemetry.end_iteration(iteration, extra=extra)
 
 
+def _checkpoint_capture(booster: Booster, cbs) -> tuple:
+    """(state, model_text) snapshot of everything resume needs: the
+    boosting loop state (gbdt.checkpoint_state), each checkpoint-aware
+    callback's state (keyed by its checkpoint_key), and the running
+    best_iteration. The model itself travels as the reference text
+    format, so a checkpoint is also a valid saved model."""
+    gbdt = booster._gbdt
+    state: Dict[str, Any] = {
+        "gbdt": gbdt.checkpoint_state(),
+        "best_iteration": int(booster.best_iteration),
+        "callbacks": {},
+    }
+    for cb in cbs:
+        key = getattr(cb, "checkpoint_key", None)
+        if key and hasattr(cb, "checkpoint_state"):
+            state["callbacks"][key] = cb.checkpoint_state()
+    return state, gbdt.save_model_to_string()
+
+
+def _checkpoint_restore(booster: Booster, cbs, state: Dict[str, Any],
+                        model_text: str) -> None:
+    booster._gbdt.restore_checkpoint_state(state["gbdt"], model_text)
+    booster.best_iteration = int(state.get("best_iteration", -1))
+    cb_states = state.get("callbacks", {})
+    for cb in cbs:
+        key = getattr(cb, "checkpoint_key", None)
+        if key and key in cb_states \
+                and hasattr(cb, "restore_checkpoint_state"):
+            cb.restore_checkpoint_state(cb_states[key])
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100, valid_sets=None, valid_names=None,
           fobj=None, feval=None, init_model=None, feature_name: str = "auto",
           categorical_feature: str = "auto",
           early_stopping_rounds: Optional[int] = None, evals_result=None,
           verbose_eval=True, learning_rates=None,
-          keep_training_booster: bool = False, callbacks=None) -> Booster:
-    """reference engine.py:18."""
+          keep_training_booster: bool = False, callbacks=None,
+          checkpoint_dir: Optional[str] = None) -> Booster:
+    """reference engine.py:18.
+
+    `checkpoint_dir` (also settable as the `checkpoint_dir` param)
+    enables preemption-safe training: atomic checkpoints every
+    `checkpoint_interval` iterations, and auto-resume from the latest
+    valid checkpoint when one exists (docs/ROBUSTNESS.md)."""
     params = copy.deepcopy(params) if params else {}
     _ensure_jit_cache()
     from .compile import preload_store_async
@@ -214,12 +251,46 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
     callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
 
+    # preemption safety (docs/ROBUSTNESS.md): periodic atomic
+    # checkpoints + auto-resume. Wired AFTER callback assembly so
+    # checkpoint-aware callbacks (early stopping, record_evaluation)
+    # can hand their state back on resume.
+    from .robust.checkpoint import CheckpointManager
+    from .robust.faultinject import check_fault
+    cfg = booster._gbdt.config
+    ckpt_dir = checkpoint_dir if checkpoint_dir else cfg.checkpoint_dir
+    ckpt_mgr = None
+    start_iteration = 0
+    if ckpt_dir:
+        from .compile import signature as S
+        digest = S._digest(S.config_signature(cfg))
+        ckpt_mgr = CheckpointManager(
+            ckpt_dir, interval=cfg.checkpoint_interval,
+            keep=cfg.checkpoint_keep, params_digest=digest)
+        if init_model is None:
+            resumed = ckpt_mgr.load_latest()
+            if resumed is not None:
+                it, ck_state, ck_model = resumed
+                _checkpoint_restore(booster, cbs, ck_state, ck_model)
+                start_iteration = it + 1
+                log.info("Resuming from checkpoint %s: %d iterations "
+                         "already trained", ckpt_mgr.path_for(it),
+                         start_iteration)
+        else:
+            # reference init_model semantics win: an explicit warm
+            # start means the caller is managing continuation itself
+            log.warning("checkpoint_dir=%s ignored for resume because "
+                        "init_model was given (checkpoints will still "
+                        "be written)", ckpt_dir)
+
     from . import obs
     telemetry = obs.TelemetrySession.from_config(booster._gbdt.config)
     if telemetry is not None:
         telemetry.start()
+    evaluation_result_list: Optional[list] = None
     try:
-        for i in range(num_boost_round):
+        for i in range(start_iteration, num_boost_round):
+            check_fault("train.iteration", index=i)
             if telemetry is not None:
                 telemetry.begin_iteration(i)
             with obs.span("before-iteration callbacks", phase="callbacks"):
@@ -256,6 +327,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 break
             if finished:
                 break
+            if ckpt_mgr is not None and ckpt_mgr.due(i):
+                with obs.span("checkpoint save", phase="checkpoint"):
+                    ck_state, ck_model = _checkpoint_capture(booster, cbs)
+                    ckpt_mgr.save(i, ck_state, ck_model)
     finally:
         if telemetry is not None:
             telemetry.close()

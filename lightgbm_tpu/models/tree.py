@@ -336,6 +336,53 @@ class Tree:
             t.threshold_in_bin = t.threshold.astype(np.int32)
         return t
 
+    def relink_to_dataset(self, dataset) -> None:
+        """Rebuild the bin-space traversal fields of a text-parsed tree
+        against `dataset`'s bin mappers.
+
+        The model text stores only real-valued thresholds and raw
+        category sets (reference format, tree.cpp:223), but train-time
+        score surgery — DART drop/normalize, rollback_one_iter — walks
+        trees over BIN codes (`leaf_index_binned`). Resuming training
+        from serialized trees therefore needs split_feature_inner,
+        threshold_in_bin, and the inner categorical bitsets recomputed.
+        Thresholds are exact bin boundaries (bin_to_value round-trips
+        through repr()), so value_to_bin recovers the original bin."""
+        ni = self.num_nodes
+        mapper_for_cat: Dict[int, object] = {}
+        for node in range(ni):
+            real = int(self.split_feature[node])
+            inner = dataset.inner_feature_index.get(real)
+            if inner is None:
+                # feature not used by this dataset: node unreachable in
+                # bin-space traversal of this data; keep a safe default
+                self.split_feature_inner[node] = 0
+                continue
+            self.split_feature_inner[node] = inner
+            mapper = dataset.bin_mappers[inner]
+            if self.decision_type[node] & K_CATEGORICAL_MASK:
+                mapper_for_cat[int(self.threshold_in_bin[node])] = mapper
+            else:
+                self.threshold_in_bin[node] = mapper.value_to_bin(
+                    float(self.threshold[node]))
+        if self.num_cat > 0:
+            bounds, bits = [0], []
+            for ci in range(self.num_cat):
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                mapper = mapper_for_cat.get(ci)
+                words: List[int] = []
+                if mapper is not None:
+                    cat2bin = mapper.categorical_2_bin
+                    bins = sorted(cat2bin[c]
+                                  for c in _from_bitset(self.cat_threshold[lo:hi])
+                                  if c in cat2bin)
+                    words = _to_bitset(bins)
+                bounds.append(bounds[-1] + len(words))
+                bits.extend(words)
+            self.cat_boundaries_inner = bounds
+            self.cat_threshold_inner = bits
+        self._device = None
+
     def to_json(self) -> dict:
         """Reference Tree::ToJSON (tree.cpp:262)."""
         d = {"num_leaves": int(self.num_leaves), "num_cat": int(self.num_cat),

@@ -22,11 +22,23 @@ manager jax.distributed auto-detects, and leave machines empty.
 from __future__ import annotations
 
 import contextlib
+import os
+import random
 import socket
+import threading
 import time
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from .utils import log
+
+# bring-up retry policy (docs/MULTIHOST.md, "Preemption and retries"):
+# a preempted peer restarting a few seconds late must not kill the
+# whole job, so initialize is retried with exponential backoff +
+# jitter. Overridable for impatient tests / patient clusters.
+_INIT_RETRIES_ENV = "LGBM_TPU_INIT_RETRIES"
+_DEFAULT_INIT_RETRIES = 3
+_BACKOFF_BASE_S = 1.0
+_BACKOFF_CAP_S = 30.0
 
 
 @contextlib.contextmanager
@@ -42,6 +54,8 @@ def collective_span(op: str, nbytes: int = 0, axis: str = ""):
     """
     from .obs import registry as _registry
     from .obs import trace as _trace
+    from .robust.faultinject import check_fault
+    check_fault("collective.dispatch")
     reg = _registry.active()
     tr = _trace.active_tracer()
     if reg is None and tr is None:
@@ -93,13 +107,35 @@ def straggler_skew(seconds: float) -> float:
 
 def parse_machine_list(machines: str) -> List[str]:
     """'ip1:port1,ip2:port2' -> ['ip1:port1', ...] (reference
-    Config::machines / machine_list_filename format)."""
+    Config::machines / machine_list_filename format).
+
+    Every entry is validated up front — a malformed entry fails HERE,
+    naming itself, instead of surfacing minutes later as an opaque
+    coordinator timeout on every healthy host."""
     out = []
     for part in str(machines).replace("\n", ",").split(","):
         part = part.strip()
         if part:
+            _validate_machine_entry(part, len(out))
             out.append(part)
     return out
+
+
+def _validate_machine_entry(entry: str, index: int) -> None:
+    """One machine-list entry must be host:port with a non-empty host
+    and a port in 1..65535 (log.fatal otherwise, naming the entry)."""
+    host, sep, port = entry.rpartition(":")
+    if not sep or not host:
+        log.fatal("machines entry %d (%r) is not host:port — every "
+                  "entry needs an explicit port (reference "
+                  "Config::machines format)", index, entry)
+    try:
+        port_num = int(port)
+    except ValueError:
+        port_num = -1
+    if not 1 <= port_num <= 65535:
+        log.fatal("machines entry %d (%r) has invalid port %r — "
+                  "expected an integer in 1..65535", index, entry, port)
 
 
 def local_addresses() -> List[str]:
@@ -151,20 +187,110 @@ def resolve_rank_all(machines: Sequence[str],
             if entry.rsplit(":", 1)[0] in local_set]
 
 
+def _classify_init_error(exc: BaseException,
+                         coordinator: str,
+                         rank: int,
+                         num_processes: int) -> Tuple[str, str]:
+    """(kind, actionable hint) for one failed initialize attempt.
+
+    jax.distributed failures all surface as RuntimeError with a gRPC
+    message buried inside; the three field failure modes need three
+    different operator actions, so the message text is classified here
+    rather than dumped raw."""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if "timed out" in text or "timeout" in text or "deadline" in text:
+        return ("timeout",
+                f"coordinator {coordinator} never assembled all "
+                f"{num_processes} processes — a peer is down, still "
+                "booting, or the machine list disagrees across hosts; "
+                "check that every host runs the same list and raise "
+                "time_out if peers boot slowly")
+    if "refused" in text or "unavailable" in text or "unreachable" in text \
+            or "no route" in text:
+        return ("refused",
+                f"nothing is listening at coordinator {coordinator} — "
+                "host 0 has not started (or a firewall drops the port); "
+                "start rank 0 first or fix the coordinator address")
+    if "process id" in text or "process_id" in text or "rank" in text \
+            or "already" in text or "mismatch" in text:
+        return ("rank mismatch",
+                f"this process claimed rank {rank} of {num_processes} "
+                "but the coordinator disagrees — two hosts resolved the "
+                "same rank (duplicate machine-list entry?) or "
+                "num_machines differs across hosts")
+    return ("unknown", "unrecognized bring-up failure; see the "
+                       "underlying error above")
+
+
+def _startup_health_barrier(timeout_s: float, _barrier=None) -> None:
+    """Post-init health check: every process must reach this barrier
+    within `timeout_s` or bring-up is declared failed.
+
+    jax.distributed.initialize returning does NOT prove the job is
+    usable — a peer can pass init and then wedge before its first
+    collective. The sync runs in a daemon thread so a hung mesh cannot
+    hang bring-up past the deadline; on timeout the job dies HERE with
+    a bring-up diagnostic instead of minutes later inside the first
+    histogram psum. `_barrier` is injectable for tests."""
+    import jax
+    if _barrier is None:
+        if jax.process_count() <= 1:
+            return
+
+        def _barrier():
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("lgbm_tpu_startup")
+
+    failure: List[BaseException] = []
+    done = threading.Event()
+
+    def _run() -> None:
+        try:
+            _barrier()
+        except BaseException as exc:  # surfaced below, not swallowed
+            failure.append(exc)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name="lgbm-tpu-startup-barrier",
+                         daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        log.fatal("startup health barrier timed out after %.0fs: "
+                  "jax.distributed initialized but the global device "
+                  "sync never completed — a peer process wedged after "
+                  "init (check its logs) or the ICI/DCN fabric is "
+                  "unhealthy", timeout_s)
+    if failure:
+        log.fatal("startup health barrier failed: %s: %s",
+                  type(failure[0]).__name__, failure[0])
+    log.debug("startup health barrier passed (%d processes)",
+              jax.process_count())
+
+
 def ensure_distributed(machines: str = "", num_machines: int = 1,
                        time_out: int = 120,
-                       _initialize=None) -> bool:
+                       _initialize=None, _sleep=None,
+                       _barrier=None) -> bool:
     """Initialize jax.distributed for a real multi-host run (no-op when
     already initialized, or when the config is single-machine, or when
     every listed machine resolves to this host — the single-controller
     multi-chip case, where num_machines is only a work-partitioning
     parameter).
 
+    Bring-up is guarded (docs/ROBUSTNESS.md): initialize is retried
+    LGBM_TPU_INIT_RETRIES times (default 3) with exponential backoff +
+    jitter — a peer restarting after preemption needs seconds, not a
+    fresh job — and a post-init health barrier proves every process is
+    actually reachable before training starts. Failures classify as
+    timeout / refused / rank-mismatch with an actionable message.
+
     Returns True when a multi-process runtime is active after the call.
     `time_out` is in MINUTES (the reference's time_out/listen_time_out
     config unit); it converts to seconds at the jax.distributed
-    boundary. `_initialize` is injectable for tests (defaults to
-    jax.distributed.initialize).
+    boundary. `_initialize` / `_sleep` / `_barrier` are injectable for
+    tests (defaults: jax.distributed.initialize / time.sleep / a real
+    global device sync).
     """
     import jax
 
@@ -178,7 +304,6 @@ def ensure_distributed(machines: str = "", num_machines: int = 1,
         # no machine list: defer to env/cluster auto-detection only if
         # the standard env vars are present; otherwise this is the
         # single-controller case (one process drives all local chips)
-        import os
         if os.environ.get("JAX_COORDINATOR_ADDRESS"):
             init = _initialize or jax.distributed.initialize
             init()   # fully env-driven
@@ -201,7 +326,6 @@ def ensure_distributed(machines: str = "", num_machines: int = 1,
                  len(mlist))
         return False
     if len(matches) > 1:
-        import os
         env_rank = os.environ.get("JAX_PROCESS_ID",
                                   os.environ.get("LGBM_TPU_RANK"))
         if env_rank is None:
@@ -217,10 +341,49 @@ def ensure_distributed(machines: str = "", num_machines: int = 1,
     else:
         rank = matches[0]
     init = _initialize or jax.distributed.initialize
-    init(coordinator_address=mlist[0], num_processes=num_machines,
-         process_id=rank,
-         initialization_timeout=int(time_out) * 60)
+    sleep = _sleep or time.sleep
+    timeout_s = int(time_out) * 60
+    try:
+        attempts = max(1, int(os.environ.get(_INIT_RETRIES_ENV,
+                                             _DEFAULT_INIT_RETRIES)))
+    except ValueError:
+        attempts = _DEFAULT_INIT_RETRIES
+    # rank-seeded jitter: every host backs off a different amount, so K
+    # preempted peers don't re-stampede the coordinator in lockstep
+    jitter_rng = random.Random(rank)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            init(coordinator_address=mlist[0],
+                 num_processes=num_machines, process_id=rank,
+                 initialization_timeout=timeout_s)
+            last = None
+            break
+        except Exception as exc:
+            last = exc
+            kind, hint = _classify_init_error(exc, mlist[0], rank,
+                                              num_machines)
+            if kind == "rank mismatch":
+                # retrying cannot fix a topology disagreement
+                log.fatal("jax.distributed bring-up failed (rank "
+                          "mismatch): %s: %s — %s",
+                          type(exc).__name__, exc, hint)
+            if attempt + 1 >= attempts:
+                break
+            delay = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
+            delay *= 1.0 + 0.25 * jitter_rng.random()
+            log.warning("jax.distributed initialize attempt %d/%d "
+                        "failed (%s): %s — retrying in %.1fs",
+                        attempt + 1, attempts, kind, exc, delay)
+            sleep(delay)
+    if last is not None:
+        kind, hint = _classify_init_error(last, mlist[0], rank,
+                                          num_machines)
+        log.fatal("jax.distributed bring-up failed after %d attempts "
+                  "(%s): %s: %s — %s", attempts, kind,
+                  type(last).__name__, last, hint)
     log.info("jax.distributed initialized: rank %d/%d, coordinator %s "
              "(Network::Init analogue; collectives ride ICI/DCN via "
              "XLA)", rank, num_machines, mlist[0])
+    _startup_health_barrier(float(timeout_s), _barrier=_barrier)
     return True

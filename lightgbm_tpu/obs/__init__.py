@@ -165,6 +165,8 @@ class TelemetrySession:
                 deactivate_tracer(self.tracer)
                 if self.trace_file:
                     try:
+                        from ..robust.faultinject import check_fault
+                        check_fault("trace.export")
                         self.tracer.export(self.trace_file)
                     except OSError as exc:
                         from ..utils import log

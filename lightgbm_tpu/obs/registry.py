@@ -168,7 +168,8 @@ class MetricsRegistry:
                 out[f"phase_{ph}_s"] = round(self.times[ph], 3)
         for key in sorted(self.counters):
             if key.startswith(("collective.", "kernel.", "compile.",
-                               "eval.", "hist.", "coll.", "trace.")):
+                               "eval.", "hist.", "coll.", "trace.",
+                               "ckpt.", "fault.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out

@@ -56,8 +56,11 @@ SCHEMA_VERSION = 1
 # when the tpulint lint.* gauges and hot_loop_syncs bench field joined,
 # to 4 when the per-pack meshlint lint.{mesh,tile,dtype}_findings
 # gauges joined, to 5 when the runtime trace timeline fields joined
-# (trace.* counters, mem.* gauges, coll.* latency/axis accounting)
-SCHEMA_MINOR = 5
+# (trace.* counters, mem.* gauges, coll.* latency/axis accounting), to
+# 6 when the fault-tolerance counters joined (ckpt.saves / ckpt.bytes /
+# ckpt.write_errors / ckpt.resume / ckpt.invalid and fault.fired /
+# fault.<seam> from robust/)
+SCHEMA_MINOR = 6
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -178,21 +181,49 @@ def validate_bench_record(rec: Any) -> List[str]:
 
 class JsonlSink:
     """Append-mode JSONL writer, flushed per line so a killed run keeps
-    every completed iteration."""
+    every completed iteration.
+
+    Telemetry must never take down training: any OSError (disk full,
+    permissions, injected fault) disables the sink with ONE warning and
+    every later write is a no-op."""
 
     def __init__(self, path: str) -> None:
         self.path = path
-        self._fh = open(path, "w")
+        try:
+            self._fh = open(path, "w")
+        except OSError as exc:
+            self._fh = None
+            self._disable(exc)
+
+    def _disable(self, exc: BaseException) -> None:
+        from ..utils import log
+        log.warning("Metrics sink %s disabled after I/O error (%s); "
+                    "training continues without JSONL metrics",
+                    self.path, exc)
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
 
     def write(self, record: Dict[str, Any]) -> None:
         if self._fh is None:
             return
-        self._fh.write(json.dumps(record) + "\n")
-        self._fh.flush()
+        try:
+            from ..robust.faultinject import check_fault
+            check_fault("sink.write")
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        except OSError as exc:
+            self._disable(exc)
 
     def close(self) -> None:
         if self._fh is not None:
-            self._fh.close()
+            try:
+                self._fh.close()
+            except OSError:
+                pass
             self._fh = None
 
 

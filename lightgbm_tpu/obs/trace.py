@@ -131,7 +131,7 @@ class Tracer:
         pid = 0
         try:
             import jax
-            pid = int(jax.process_index())
+            pid = int(jax.process_index())  # tpulint: sync-ok(export-time only: to_perfetto runs once at session close, never inside the iteration loop — the hot edge is a name-collision on close() via JsonlSink._disable)
         except Exception:
             pass
         events: List[Dict[str, Any]] = [

@@ -1,0 +1,22 @@
+"""Fault tolerance: checkpoint/resume + deterministic fault injection.
+
+Two halves (docs/ROBUSTNESS.md):
+
+- `checkpoint`: periodic atomic training checkpoints (model text + full
+  loop state) and resume — a preempted run continues from the last
+  checkpoint and, under `deterministic=true`, finishes with a model
+  text byte-identical to the uninterrupted run.
+- `faultinject`: named injection seams (checkpoint writes, AOT-store
+  reads, the boosting loop, collective dispatch, telemetry sinks)
+  driven by the `LGBM_TPU_FAULT_PLAN` spec, so every recovery path has
+  a test that actually exercises the failure.
+"""
+from .checkpoint import CheckpointError, CheckpointManager
+from .faultinject import (FaultPlan, active_plan, check_fault,
+                          filter_bytes, install_plan)
+
+__all__ = [
+    "CheckpointError", "CheckpointManager",
+    "FaultPlan", "active_plan", "check_fault", "filter_bytes",
+    "install_plan",
+]

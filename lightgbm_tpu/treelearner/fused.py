@@ -1675,6 +1675,44 @@ class FusedSerialGrower:
             out = out[:self.actual_rows]
         return out
 
+    # -- checkpoint/resume (robust/checkpoint.py) ----------------------
+    def persistent_lane_state(self, data):
+        """(rowid_lanes, score_bits) — the two planes of the persistent
+        state that evolve irrecoverably. The LANE ORDER is part of the
+        numeric state (histogram and score accumulation follow it), so
+        checkpointing row-order scores would not resume bit-identically;
+        every other plane is a pure function of the dataset gathered
+        through the rowid plane and is rebuilt on restore."""
+        Ly = self.layout
+        # tpulint: sync-ok(checkpoint capture; periodic, off the iteration path)
+        rowid, score_bits = jax.device_get([data[Ly.rowid], data[Ly.score]])
+        return np.asarray(rowid, np.int32), np.asarray(score_bits, np.int32)
+
+    def restore_persistent_state(self, rowid_lanes, score_bits) -> jax.Array:
+        """Rebuild the planar state from a checkpoint's lane planes.
+        Partitions only permute lanes within [0, actual_rows), so codes
+        / label / weight at lane j equal the dataset values of row
+        rowid[j]; grad/hess are dead between iterations (set_gh
+        overwrites them before any read); the score plane is restored
+        bit-exactly from the saved words."""
+        assert self.persistent_capable
+        Ly = self.layout
+        n = self.actual_rows
+        rid = jnp.asarray(np.asarray(rowid_lanes, np.int32))
+        rid_n = rid[:n]
+        aux_label, aux_weight = self.objective.persistent_aux()
+        cp = plane.build_codes_planes(self.bins[rid_n], Ly)
+        lab = jnp.asarray(aux_label, jnp.float32)[rid_n]
+        wgt = None if aux_weight is None \
+            else jnp.asarray(aux_weight, jnp.float32)[rid_n]
+        zeros = jnp.zeros(n, jnp.float32)
+        data = plane.build_data(Ly, cp, zeros, zeros, rowid=rid,
+                                label=lab, score=zeros, weight=wgt)
+        data = data.at[Ly.score].set(
+            jnp.asarray(np.asarray(score_bits, np.int32)))
+        self._codes_planes_dev = None
+        return data
+
     # ------------------------------------------------------------------
     def _traverse_device(self, ta) -> jax.Array:
         return self.traverse_bins(ta, self.bins)

@@ -1,0 +1,142 @@
+"""HIGGS-scale chaos drill: SIGKILL a real training run mid-flight,
+resume it from the surviving checkpoints, and prove the final model is
+byte-identical to an uninterrupted run (docs/ROBUSTNESS.md).
+
+The harness is self-invoking: the parent re-executes THIS script as a
+child process per run. Run 1 trains with a `train.iteration:sigkill@K`
+fault plan armed, so the child is SIGKILLed (no atexit, no flush — the
+honest preemption simulator) entering iteration K; run 2 resumes from
+the checkpoint directory with no plan armed; run 3 is the
+uninterrupted baseline. The drill passes iff run 2's and run 3's saved
+model text hash identically.
+
+Run on the chip (or anywhere):  python scripts/chaos_train.py
+Env: CHAOS_ROWS (default 1_000_000), CHAOS_COLS (default 28 — the
+HIGGS width), CHAOS_ITERS (default 60), CHAOS_KILL_AT (default
+ITERS // 2 + 1), CHAOS_INTERVAL (checkpoint interval, default 10),
+CHAOS_FUSED (1/0, default 1).
+"""
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("CHAOS_ROWS", 1_000_000))
+COLS = int(os.environ.get("CHAOS_COLS", 28))
+ITERS = int(os.environ.get("CHAOS_ITERS", 60))
+KILL_AT = int(os.environ.get("CHAOS_KILL_AT", ITERS // 2 + 1))
+INTERVAL = int(os.environ.get("CHAOS_INTERVAL", 10))
+FUSED = os.environ.get("CHAOS_FUSED", "1") != "0"
+
+
+def make_higgs_like(n, f, seed=17):
+    """Synthetic HIGGS-shaped binary problem (28 dense physics-style
+    features, weak nonlinear signal)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    logit = (1.3 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+             + 0.5 * np.sin(X[:, 4]))
+    y = (logit + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+def child_train(ckpt_dir: str, out_path: str) -> None:
+    """One training run (executed in a child process): train with
+    periodic checkpoints — auto-resuming if the directory already holds
+    one — and write the final model text to `out_path`."""
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(ROWS, COLS)
+    params = {"objective": "binary", "verbose": -1,
+              "num_leaves": 63, "learning_rate": 0.1,
+              "tpu_fused": FUSED,
+              "checkpoint_interval": INTERVAL}
+    t0 = time.time()
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=ITERS, verbose_eval=False,
+                    checkpoint_dir=ckpt_dir if ckpt_dir else None)
+    text = bst.model_to_string()
+    with open(out_path, "w") as fh:
+        fh.write(text)
+    print(f"[child] trained {bst.num_trees()} trees in "
+          f"{time.time() - t0:.1f}s -> {out_path}", flush=True)
+
+
+def run_child(ckpt_dir: str, out_path: str, fault_plan: str = "") -> int:
+    env = dict(os.environ)
+    env.pop("LGBM_TPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["LGBM_TPU_FAULT_PLAN"] = fault_plan
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--child", ckpt_dir, out_path]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env)
+    print(f"[parent] child rc={proc.returncode} "
+          f"({time.time() - t0:.1f}s, plan={fault_plan or 'none'})",
+          flush=True)
+    return proc.returncode
+
+
+def sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_train(sys.argv[2], sys.argv[3])
+        return 0
+
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_chaos_")
+    ckpt_dir = os.path.join(work, "ckpt")
+    out_resumed = os.path.join(work, "model_resumed.txt")
+    out_fresh = os.path.join(work, "model_fresh.txt")
+    print(f"[parent] {ROWS} rows x {COLS} cols, {ITERS} iters, "
+          f"SIGKILL entering iteration {KILL_AT}, checkpoint every "
+          f"{INTERVAL} (dir {ckpt_dir})", flush=True)
+
+    # run 1: die mid-train
+    rc = run_child(ckpt_dir, out_resumed,
+                   fault_plan=f"train.iteration:sigkill@{KILL_AT}")
+    if rc != -signal.SIGKILL:
+        print(f"FAIL: chaos child exited rc={rc}, expected SIGKILL "
+              f"({-signal.SIGKILL})")
+        return 1
+    survivors = sorted(n for n in os.listdir(ckpt_dir)
+                       if n.endswith(".lgbckpt"))
+    if not survivors:
+        print("FAIL: no checkpoint survived the kill")
+        return 1
+    print(f"[parent] survivors: {survivors}", flush=True)
+
+    # run 2: resume to completion
+    if run_child(ckpt_dir, out_resumed) != 0:
+        print("FAIL: resume run did not complete")
+        return 1
+
+    # run 3: uninterrupted baseline
+    if run_child("", out_fresh) != 0:
+        print("FAIL: baseline run did not complete")
+        return 1
+
+    h_resumed, h_fresh = sha(out_resumed), sha(out_fresh)
+    print(f"[parent] resumed  {h_resumed}")
+    print(f"[parent] baseline {h_fresh}")
+    if h_resumed != h_fresh:
+        print("FAIL: resumed model text differs from the uninterrupted "
+              "baseline — resume is not bit-identical")
+        return 1
+    print("PASS: killed + resumed training is byte-identical to the "
+          "uninterrupted run")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -490,7 +490,12 @@ class TestSklearn:
         reg = LGBMRegressor(n_estimators=30).fit(X, y)
         assert np.mean((reg.predict(X) - y) ** 2) < 0.5
 
+    @pytest.mark.slow
     def test_regressor_early_stopping(self):
+        """Slow-marked: early stopping is tier-1-covered in
+        TestTrainingControl::test_early_stopping and
+        test_robust.py::test_early_stopping_resume; this re-proves the
+        sklearn-wrapper plumbing over 100 candidate rounds (21s)."""
         X, y = make_regression(2400)
         from lightgbm_tpu.sklearn import LGBMRegressor
         reg = LGBMRegressor(n_estimators=100)

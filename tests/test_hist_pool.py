@@ -15,7 +15,12 @@ def make_data(n=1500, f=40, seed=9):
     return X, y
 
 
+@pytest.mark.slow
 def test_pool_cap_matches_unlimited_fused():
+    """Slow-marked (tier-1 budget): the serial pool-cap parity twin is
+    already slow-marked for the same reason; pool-cap correctness under
+    the fused learner re-proves composition of two tier-1-covered
+    pieces (14s)."""
     X, y = make_data()
     base = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 20,
             "num_leaves": 31}

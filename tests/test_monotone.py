@@ -39,10 +39,15 @@ def test_monotone_methods_enforce_slopes(method):
     assert imp[2] > 0
 
 
+@pytest.mark.slow
 def test_intermediate_at_least_as_accurate_as_basic():
     """The reference's selling point for 'intermediate': less constraint
     slack => typically better fit. Allow equality wiggle but catch
-    regressions where intermediate breaks the model."""
+    regressions where intermediate breaks the model.
+
+    Slow-marked (tier-1 budget): enforcement of both methods stays
+    tier-1 via test_monotone_methods_enforce_slopes; this is a
+    quality-comparison re-proof (13s)."""
     X, y = make_mono_data()
     base = {"objective": "regression", "verbose": -1,
             "min_data_in_leaf": 20, "num_leaves": 31, "metric": "l2",
