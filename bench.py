@@ -85,7 +85,8 @@ STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "iters_done": 0, "iter_times": [], "test_auc": None,
          "example_auc": None, "predict_us_per_row": None,
          "example_auc_reference": None, "hist_method": None,
-         "hot_loop_syncs": None}
+         "hot_loop_syncs": None, "overlap_share": None,
+         "blocking_syncs_per_iter": None}
 # obs.MetricsRegistry activated in main() once lightgbm_tpu is imported;
 # emit() appends its per-phase breakdown AFTER the pre-existing keys so
 # the line stays byte-compatible on everything consumers already parse
@@ -179,6 +180,15 @@ def emit(partial: bool) -> None:
     # the package AST would blow the signal budget
     if STATE["hot_loop_syncs"] is not None:
         out["hot_loop_syncs"] = STATE["hot_loop_syncs"]
+    # async pipelined iteration (schema minor 7): runtime evidence from
+    # the sync-traced streamed window — fraction of streamed wall-clock
+    # the host spent NOT blocked in a device sync, and blocking host
+    # syncs per streamed iteration (the dispatch-ahead loop's gate)
+    if STATE["overlap_share"] is not None:
+        out["overlap_share"] = round(STATE["overlap_share"], 4)
+    if STATE["blocking_syncs_per_iter"] is not None:
+        out["blocking_syncs_per_iter"] = round(
+            STATE["blocking_syncs_per_iter"], 4)
     # runtime trace timeline (schema minor 5)
     if TRACE:
         out["trace_file"] = TRACE
@@ -403,19 +413,41 @@ def main():
         if STATE["iter_times"] else 1.0
     room = BUDGET * 0.9 - (time.time() - T0) - 60.0
     target = min(ITERS, STATE["iters_done"] + max(0, int(room / per_iter)))
-    while STATE["iters_done"] < target:
-        bst.update()
-        STATE["iters_done"] += 1
-        if STATE["iters_done"] % 50 == 0:
-            jax.block_until_ready(bst._gbdt.device_score_state())
-            # keep the partial-emit path honest: a SIGTERM between
-            # checkpoints reports the true streamed elapsed over the
-            # CONFIRMED iteration count
-            STATE["train_s"] = time.time() - t_train0
-            STATE["train_iters"] = STATE["iters_done"] - 1
-            if time.time() - T0 > BUDGET * 0.85:
-                break
-    jax.block_until_ready(bst._gbdt.device_score_state())
+    # async-pipeline runtime evidence (schema minor 7): a local tracer
+    # window around the streamed loop records every blocking host sync
+    # (jax.device_get / jax.block_until_ready) so the summary line can
+    # report overlap_share and blocking_syncs_per_iter
+    sync_tr = lgb.obs.Tracer()
+    lgb.obs.activate_tracer(sync_tr)
+    traced = lgb.obs.install_sync_tracing()
+    stream_iters0 = STATE["iters_done"]
+    stream_t0 = time.time()
+    try:
+        while STATE["iters_done"] < target:
+            sync_tr.iteration = STATE["iters_done"]
+            bst.update()
+            STATE["iters_done"] += 1
+            if STATE["iters_done"] % 50 == 0:
+                jax.block_until_ready(bst._gbdt.device_score_state())
+                # keep the partial-emit path honest: a SIGTERM between
+                # checkpoints reports the true streamed elapsed over the
+                # CONFIRMED iteration count
+                STATE["train_s"] = time.time() - t_train0
+                STATE["train_iters"] = STATE["iters_done"] - 1
+                if time.time() - T0 > BUDGET * 0.85:
+                    break
+        jax.block_until_ready(bst._gbdt.device_score_state())
+    finally:
+        stream_wall = time.time() - stream_t0
+        if traced:
+            lgb.obs.uninstall_sync_tracing()
+        lgb.obs.deactivate_tracer(sync_tr)
+    streamed = STATE["iters_done"] - stream_iters0
+    if streamed > 0 and stream_wall > 0:
+        sync_evs = [ev for ev in sync_tr.buf if ev[2] == "sync"]
+        STATE["blocking_syncs_per_iter"] = len(sync_evs) / streamed
+        STATE["overlap_share"] = max(0.0, min(1.0, 1.0 - sum(
+            ev[4] for ev in sync_evs) / 1e9 / stream_wall))
     # train_s covers iterations 2..N (the first rode with the compile)
     STATE["train_s"] = time.time() - t_train0
     STATE["train_iters"] = STATE["iters_done"] - 1

@@ -478,17 +478,21 @@ class Booster:
             raise LightGBMError(f"No validation set named {name}")
         return self._eval_set(f"valid_{idx}", name, feval)
 
-    def eval_train(self, feval=None):
-        return self._eval_set("training", "training", feval)
+    def eval_train(self, feval=None, res=None):
+        return self._eval_set("training", "training", feval, res=res)
 
-    def eval_valid(self, feval=None):
+    def eval_valid(self, feval=None, res=None):
         out = []
         for i, name in enumerate(self.name_valid_sets):
-            out += self._eval_set(f"valid_{i}", name, feval)
+            out += self._eval_set(f"valid_{i}", name, feval, res=res)
         return out
 
-    def _eval_set(self, key: str, display_name: str, feval=None):
-        res = self._gbdt.eval_at_iter()
+    def _eval_set(self, key: str, display_name: str, feval=None, res=None):
+        # `res` lets the pipelined engine loop resolve ONE
+        # begin_eval_at_iter handle and fan its rows out to every
+        # dataset filter, instead of re-evaluating per call
+        if res is None:
+            res = self._gbdt.eval_at_iter()
         out = [(display_name, mname, val, bib)
                for ds, mname, val, bib in res if ds == key]
         if feval is not None:

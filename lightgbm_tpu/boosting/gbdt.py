@@ -189,6 +189,14 @@ class GBDT:
             "LGBM_TPU_ITER_BATCH", "1")))
         self._pq_trees: list = []
         self._pq_masks: list = []
+        # dispatch-ahead / fetch-behind pipelining (LGBM_TPU_PIPELINE=0
+        # restores the fully synchronous loop — the parity reference):
+        # the periodic stop-check readback trails one check period
+        # behind its dispatch, so the host never blocks on it while
+        # device work is in flight
+        self._pipeline = os.environ.get("LGBM_TPU_PIPELINE", "1") != "0"
+        self._stop_fetch = None    # in-flight trailing stop-check
+        self._stop_pending = None  # drained-but-unconsumed stop verdict
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
 
@@ -368,12 +376,27 @@ class GBDT:
                 self._invalidate_fused_state()
             return self._train_one_iter_fused(init_scores)
 
+        tl = self.tree_learner
+        gh: list = []
+        for c in range(k):
+            if self.class_need_train[c] and self.train_data.num_features > 0:
+                gh.append((self._grad[c], self._hess[c]))
+                if hasattr(tl, "prefetch_quantize"):
+                    # dispatch-ahead quantization: every class-tree's
+                    # quantize (and its stochastic-rounding draw) is
+                    # enqueued up front, so the packed plane for tree
+                    # c+1 builds while tree c's host-driven growth —
+                    # and its leaf-renewal readback — is still running
+                    tl.prefetch_quantize(*gh[-1])
+            else:
+                gh.append((None, None))
+
         should_continue = False
         for c in range(k):
             if self.class_need_train[c] and self.train_data.num_features > 0:
                 with obs_span("gbdt/grow_tree (host loop)", phase="grow"):
                     new_tree = self.tree_learner.grow(
-                        self._grad[c], self._hess[c], self._perm,
+                        gh[c][0], gh[c][1], self._perm,
                         self.bag_data_cnt)
             else:
                 new_tree = Tree(2)
@@ -446,13 +469,9 @@ class GBDT:
             pending.add_bias(init_scores[0])
         self.models.append(pending)
         self.iter += 1
-        if self.iter % self._fused_check_every == 0:
-            if all(v <= 1 for v in
-                   self._batched_tree_stats(self.models[-1:])[0]):
-                self._trim_degenerate_tail()
-                log.warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                return True
+        if self.iter % self._fused_check_every == 0 and \
+                self._periodic_stop_check(self.models[-1:]):
+            return True
         return False
 
     def _train_one_iter_fused(self, init_scores) -> bool:
@@ -480,14 +499,117 @@ class GBDT:
         # deferred no-more-splits detection: syncing every iteration
         # would cost a tunnel round trip, so check periodically and
         # roll back ALL trailing degenerate iterations on detection
-        if self.iter % self._fused_check_every == 0:
-            if all(v <= 1 for v in
-                   self._batched_tree_stats(self.models[-k:])[0]):
-                self._trim_degenerate_tail()
-                log.warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                return True
+        if self.iter % self._fused_check_every == 0 and \
+                self._periodic_stop_check(self.models[-k:]):
+            return True
         return False
+
+    def _periodic_stop_check(self, trees) -> bool:
+        """Deferred no-more-splits detection shared by the fused paths.
+        Pipelined (default): resolve the verdict whose readback was
+        DISPATCHED at the previous check — it has been in flight for a
+        whole check period, so the host never blocks on it — then kick
+        off this period's readback. Stopping therefore trails detection
+        by one period; the final model is unaffected because
+        _trim_degenerate_tail removes ALL trailing degenerate
+        iterations either way. LGBM_TPU_PIPELINE=0 restores the
+        synchronous order (dispatch, then resolve immediately)."""
+        if self._pipeline:
+            stop = self._resolve_stop_check()
+            self._begin_stop_check(trees)
+        else:
+            self._begin_stop_check(trees)
+            stop = self._resolve_stop_check()
+        if stop:
+            trimmed = self._trim_degenerate_tail()
+            if trimmed == 0 and \
+                    len(self.models) > self.num_tree_per_iteration:
+                # stale verdict: the window it covered was degenerate
+                # but later iterations found splits again — keep going
+                return False
+            log.warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
+        return False
+
+    def _begin_stop_check(self, trees) -> None:
+        """Start the leaf-count readback for ``trees`` without blocking:
+        collect the same per-tree scalar refs _batched_tree_stats would
+        and begin their device->host copy. _resolve_stop_check reads
+        the verdict later (one check period later in steady state)."""
+        from .. import obs
+        from ..treelearner.fused import PendingTree
+        refs: list = []
+        counts: list = []
+        for t in trees:
+            if isinstance(t, PendingTree) and t._tree is None:
+                if t._ta is None and t.batch is None \
+                        and t.resolver is not None:
+                    t.resolver()   # dispatch queued iterations first
+                if t._n_leaves_host is not None:
+                    counts.append(int(t._n_leaves_host))
+                    continue
+                stacked = t._ta is None and t.batch is not None \
+                    and t.batch._host is None
+                src = t.batch.stack if stacked else t.tree_arrays
+                ref = src["n_leaves"][t.index] if stacked \
+                    else src["n_leaves"]
+                try:
+                    ref.copy_to_host_async()
+                except Exception:
+                    pass   # host copy is an optimization, not a contract
+                refs.append((t, ref))
+            else:
+                tree = t._tree if isinstance(t, PendingTree) else t
+                counts.append(int(tree.num_leaves))
+        tr = obs.active_tracer()
+        self._stop_fetch = (refs, counts, self.iter,
+                            tr.iteration if tr is not None else -1)
+        if refs:
+            reg = obs.active()
+            if reg is not None:
+                reg.inc("pipeline.inflight_fetches")
+
+    def _resolve_stop_check(self) -> bool:
+        """Verdict of the previously dispatched stop check: True when
+        every tree in that window was a single leaf. Returns False when
+        nothing is in flight (first check of a run, or after resume)."""
+        from .. import obs
+        if self._stop_pending is not None:
+            out, self._stop_pending = self._stop_pending, None
+            return bool(out)
+        if self._stop_fetch is None:
+            return False
+        refs, counts, disp_iter, disp_trace_iter = self._stop_fetch
+        self._stop_fetch = None
+        counts = list(counts)
+        if refs:
+            with obs_span("trailing stop-check (readback)",
+                          phase="stop_check"), \
+                    obs.sync_attribution(disp_trace_iter):
+                # tpulint: sync-ok(trailing-fetch: resolves the readback dispatched one check period earlier, already host-resident in steady state)
+                vals = jax.device_get([r for _, r in refs])
+            for (t, _), v in zip(refs, vals):
+                if t._n_leaves_host is None:
+                    t._n_leaves_host = int(v)
+                counts.append(int(v))
+        stop = bool(counts) and all(v <= 1 for v in counts)
+        if stop and self.iter > disp_iter:
+            reg = obs.active()
+            if reg is not None:
+                # iterations trained past the detected degenerate window
+                # (all trimmed again by _trim_degenerate_tail)
+                reg.inc("pipeline.delayed_stop_iters",
+                        self.iter - disp_iter)
+        return stop
+
+    def _drain_stop_check(self) -> None:
+        """Resolve any in-flight trailing stop-check and park the
+        verdict for the next periodic check. Checkpoint capture and
+        state restores call this: a checkpoint must not carry live
+        device refs, and a positive verdict must survive resume."""
+        if self._stop_fetch is not None:
+            self._stop_pending = self._resolve_stop_check() or None
 
     def _tree_num_leaves(self, t) -> int:
         """Leaf count without forcing a full host materialization."""
@@ -691,16 +813,27 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_at_iter(self) -> Dict[str, List[Tuple[str, str, float, bool]]]:
         """All metric values: list of (dataset_name, metric_name, value,
-        bigger_is_better).
+        bigger_is_better). Synchronous form of the begin/finish pair
+        below — dispatch and resolve back to back."""
+        return self.finish_eval_at_iter(self.begin_eval_at_iter())
+
+    def begin_eval_at_iter(self):
+        """Dispatch this iteration's metric evaluation; the scalar
+        readback starts immediately but is NOT waited on. Returns an
+        opaque handle for finish_eval_at_iter, which the pipelined
+        engine loop resolves one iteration later, while the next
+        iteration's device work is already in flight.
 
         Metrics with a device reduction (metric/metrics.py eval_device)
         are reduced ON DEVICE and only their scalars transferred — one
         batched device_get for the whole eval, instead of an [N]-sized
         np.asarray per dataset per iteration. Host fallback covers
         averaged-output models (DART weights need the host divide),
-        multiclass score blocks, and metrics without a device path."""
-        from ..obs import active as obs_active
-        reg = obs_active()
+        multiclass score blocks, and metrics without a device path;
+        fallback metrics evaluate eagerly here (they need the host
+        score either way)."""
+        from .. import obs
+        reg = obs.active()
         out: list = []
         dev_slots: list = []    # (out index, 0-d device array)
         div = 1.0
@@ -739,10 +872,28 @@ class GBDT:
             eval_set("training", self.metrics, self.get_training_score())
         for i, ms in enumerate(self.valid_metrics):
             eval_set(f"valid_{i}", ms, self.valid_score[i].score)
+        for _, v in dev_slots:
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass   # host copy is an optimization, not a contract
+        if dev_slots and reg is not None:
+            reg.inc("pipeline.inflight_fetches")
+        tr = obs.active_tracer()
+        return (out, dev_slots, tr.iteration if tr is not None else -1)
+
+    def finish_eval_at_iter(self, handle):
+        """Resolve a begin_eval_at_iter handle: one batched device_get
+        over every device-reduced scalar of that eval. In the pipelined
+        engine loop the handle is one iteration old, so the scalars are
+        already host-resident and the fetch does not block."""
+        from .. import obs
+        out, dev_slots, disp_iter = handle
         if dev_slots:
-            # ONE transfer for every device-reduced scalar of this eval
-            # tpulint: sync-ok(batched eval scalars, one transfer per eval)
-            vals = jax.device_get([v for _, v in dev_slots])
+            reg = obs.active()
+            with obs.sync_attribution(disp_iter):
+                # tpulint: sync-ok(trailing-fetch: batched eval scalars dispatched an iteration earlier; one transfer per eval)
+                vals = jax.device_get([v for _, v in dev_slots])
             for (idx, _), v in zip(dev_slots, vals):
                 out[idx][2] = float(v)
             if reg is not None:
@@ -1030,6 +1181,9 @@ class GBDT:
         accumulation order and drift in the last ulp)."""
         self._flush_persistent_queue()
         self._materialize_models()
+        # the pipelined loop must not leak live device refs into the
+        # checkpoint; a drained positive verdict is persisted instead
+        self._drain_stop_check()
         st: Dict = {
             "iter": int(self.iter),
             "num_init_iteration": int(self.num_init_iteration),
@@ -1062,12 +1216,19 @@ class GBDT:
         else:
             st["train_score"] = np.asarray(self.get_training_score())
         st["valid_scores"] = [np.asarray(vs.score) for vs in self.valid_score]
+        if self._stop_pending:
+            st["stop_pending"] = True
         return st
 
     def restore_checkpoint_state(self, state: Dict, model_text: str) -> None:
         """Inverse of checkpoint_state against a freshly-initialized
         booster on the same dataset/config."""
         self._pred_revision = getattr(self, "_pred_revision", 0) + 1
+        # in-flight refs never cross a checkpoint boundary; a drained
+        # positive verdict resumes via the additive "stop_pending" key
+        # (absent in older checkpoints -> no verdict, same as before)
+        self._stop_fetch = None
+        self._stop_pending = True if state.get("stop_pending") else None
         self.models = list(parse_tree_blocks(model_text))
         # the text format drops bin-space fields; train-time score
         # surgery (DART drop/normalize, rollback) traverses in bin

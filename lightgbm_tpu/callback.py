@@ -219,7 +219,16 @@ class _MetricState:
 
 class _EarlyStopper:
     """Stops when no tracked validation metric improved for
-    ``stopping_rounds`` consecutive rounds."""
+    ``stopping_rounds`` consecutive rounds.
+
+    Delayed-invocation contract (engine pipelining): the engine's
+    dispatch-ahead loop may call after-iteration callbacks for
+    iteration t while iteration t+1 is already training. Each callback
+    still receives its own iteration's ``env`` (iteration index AND
+    evaluation list), so the stop decision and ``best_round`` are
+    identical to the synchronous loop — the run just carries at most
+    one extra tree past the stop, which the recorded best_iteration
+    truncates out of the saved model."""
 
     order = 30
     checkpoint_key = "early_stopping"

@@ -50,6 +50,28 @@ _MAX_SHARED_ENTRIES = 32   # LRU cap: entries close over growers/datasets
 _MAX_EXECUTABLES = 128
 
 
+def _count_donated_bytes(donate_argnums: Tuple[int, ...],
+                         args: Tuple[Any, ...]) -> None:
+    """pipeline.donated_bytes: HBM handed back to the allocator by a
+    donating dispatch. Reads only .nbytes metadata — never the buffer
+    contents — so it is safe on arguments about to be donated (and on
+    already-deleted leaves, which may raise from their accessors)."""
+    from .. import obs
+    reg = obs.active()
+    if reg is None:
+        return
+    total = 0
+    for i in donate_argnums:
+        if i < len(args):
+            for leaf in jax.tree_util.tree_leaves(args[i]):
+                try:
+                    total += int(getattr(leaf, "nbytes", 0) or 0)
+                except Exception:
+                    continue
+    if total:
+        reg.inc("pipeline.donated_bytes", total)
+
+
 def _aot_supported() -> bool:
     try:
         from jax.experimental import serialize_executable  # noqa: F401
@@ -63,10 +85,12 @@ class SharedEntry:
     whose compile signatures match. Calling it dispatches AOT-first."""
 
     def __init__(self, manager: "CompileManager", name: str,
-                 digest: str, build: Callable[[], Callable]) -> None:
+                 digest: str, build: Callable[[], Callable],
+                 donate_argnums: Tuple[int, ...] = ()) -> None:
         self.manager = manager
         self.name = name
         self.digest = digest
+        self.donate_argnums = tuple(donate_argnums)
         self._build = build
         self._jfn: Optional[Callable] = None
         # guards _jfn / _key_cache / specs: entries are shared across
@@ -101,6 +125,8 @@ class SharedEntry:
 
     def __call__(self, *args: Any, **statics: Any) -> Any:
         mgr = self.manager
+        if self.donate_argnums:
+            _count_donated_bytes(self.donate_argnums, args)
         if not mgr.aot_enabled:
             return self.jit_fn()(*args, **statics)
         key = self.key_for(args, statics)
@@ -129,9 +155,11 @@ class JitEntry:
     zero-recompile acceptance check sees every entry in the stack."""
 
     def __init__(self, manager: "CompileManager", name: str,
-                 jfn: Callable) -> None:
+                 jfn: Callable,
+                 donate_argnums: Tuple[int, ...] = ()) -> None:
         self.manager = manager
         self.name = name
+        self.donate_argnums = tuple(donate_argnums)
         self._jfn = jfn
 
     def __getattr__(self, item: str) -> Any:
@@ -144,6 +172,8 @@ class JitEntry:
             return None
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self.donate_argnums:
+            _count_donated_bytes(self.donate_argnums, args)
         before = self._cache_size()
         t0 = time.perf_counter()
         out = self._jfn(*args, **kwargs)
@@ -196,24 +226,31 @@ class CompileManager:
 
     # -- registration ---------------------------------------------------
     def shared_entry(self, name: str, sig: Any,
-                     build: Callable[[], Callable]) -> SharedEntry:
+                     build: Callable[[], Callable],
+                     donate_argnums: Tuple[int, ...] = ()) -> SharedEntry:
         """The entry for (name, signature), creating it on first use.
         A pre-existing entry keeps ITS builder: signatures are defined
-        precisely so equal digests trace identical programs."""
-        digest = S.signature_digest(name, sig)
+        precisely so equal digests trace identical programs.
+        `donate_argnums` declares which positional args the built
+        program donates; it refines the digest (and hence every AOT key
+        under it), so toggling donation can never replay an executable
+        with the wrong aliasing — and can never retrace one that has
+        the right aliasing."""
+        digest = S.signature_digest(name, sig, donate_argnums)
         with self._lock:
             entry = self.shared.get(digest)
             if entry is not None:
                 self.shared.move_to_end(digest)
                 return entry
-            entry = SharedEntry(self, name, digest, build)
+            entry = SharedEntry(self, name, digest, build, donate_argnums)
             self.shared[digest] = entry
             while len(self.shared) > _MAX_SHARED_ENTRIES:
                 self.shared.popitem(last=False)
             return entry
 
-    def jit_entry(self, name: str, jfn: Callable) -> JitEntry:
-        return JitEntry(self, name, jfn)
+    def jit_entry(self, name: str, jfn: Callable,
+                  donate_argnums: Tuple[int, ...] = ()) -> JitEntry:
+        return JitEntry(self, name, jfn, donate_argnums)
 
     # -- dispatch -------------------------------------------------------
     def _key_lock(self, key: str) -> threading.Lock:

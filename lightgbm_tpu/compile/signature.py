@@ -175,8 +175,18 @@ def environment_key() -> str:
     return _digest(env)
 
 
-def signature_digest(name: str, sig: Any) -> str:
-    return _digest({"entry": name, "sig": sig})
+def signature_digest(name: str, sig: Any,
+                     donate_argnums: Tuple[int, ...] = ()) -> str:
+    """Entry-point identity. Donation is part of the traced program
+    (XLA bakes input/output aliasing into the executable), so donating
+    entries must never alias a non-donating executable of the same
+    name+sig — the donate tuple joins the digest. Omitted when empty so
+    every pre-existing non-donating digest (and its serialized store
+    blobs) stays byte-identical."""
+    payload: Dict[str, Any] = {"entry": name, "sig": sig}
+    if donate_argnums:
+        payload["donate"] = sorted(int(i) for i in donate_argnums)
+    return _digest(payload)
 
 
 def shape_signature(args: Any, statics: Dict[str, Any]) -> Tuple:

@@ -287,7 +287,44 @@ def train(params: Dict[str, Any], train_set: Dataset,
     telemetry = obs.TelemetrySession.from_config(booster._gbdt.config)
     if telemetry is not None:
         telemetry.start()
+    # dispatch-ahead pipelining (default; LGBM_TPU_PIPELINE=0 restores
+    # the fully synchronous loop): iteration t's eval-scalar readback
+    # and after-iteration callbacks run only after iteration t+1's
+    # device work has been dispatched, so the host never idles waiting
+    # for metrics. Early stopping therefore observes iteration t one
+    # step late — it can never stop EARLIER than the synchronous loop,
+    # trains at most one extra tree, and records the same
+    # best_iteration (which the saved model is truncated to, so saved
+    # output is identical). Telemetry mode stays synchronous: its
+    # per-iteration stream sync serializes the loop anyway, and every
+    # JSONL record must carry its own iteration's metrics.
+    # feval also forces the synchronous loop: a custom eval reads the
+    # LIVE score arrays at call time, so a deferred call would see the
+    # next iteration's scores
+    pipeline = (telemetry is None and feval is None
+                and os.environ.get("LGBM_TPU_PIPELINE", "1") != "0")
     evaluation_result_list: Optional[list] = None
+    pending = None    # (iteration, unresolved eval handle)
+
+    def _resolve_evals(handle) -> list:
+        evals: list = []
+        with obs.span("metric evaluation (resolve)", phase="eval"):
+            res = booster._gbdt.finish_eval_at_iter(handle) \
+                if handle is not None else None
+            if valid_contain_train:
+                evals.extend((train_data_name, m, v, b)
+                             for _, m, v, b
+                             in booster.eval_train(feval, res=res))
+            if booster.name_valid_sets:
+                evals.extend(booster.eval_valid(feval, res=res))
+        return evals
+
+    def _after_callbacks(it: int, evals) -> None:
+        for cb in callbacks_after:
+            cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                        iteration=it, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=evals))
     try:
         for i in range(start_iteration, num_boost_round):
             check_fault("train.iteration", index=i)
@@ -304,33 +341,79 @@ def train(params: Dict[str, Any], train_set: Dataset,
                           phase="update"):
                 finished = booster.update(fobj=fobj)
 
-            evaluation_result_list = []
             with obs.span("metric evaluation", phase="eval"):
-                if valid_contain_train:
-                    evaluation_result_list.extend(
-                        (train_data_name, m, v, b)
-                        for _, m, v, b in booster.eval_train(feval))
-                if booster.name_valid_sets:
-                    evaluation_result_list.extend(booster.eval_valid(feval))
+                eval_handle = (
+                    booster._gbdt.begin_eval_at_iter()
+                    if valid_contain_train or booster.name_valid_sets
+                    else None)
             if telemetry is not None:
+                evaluation_result_list = _resolve_evals(eval_handle)
+                eval_handle = None
                 _telemetry_end_iteration(telemetry, booster, i,
                                          evaluation_result_list)
+            drained_it = i
             try:
-                for cb in callbacks_after:
-                    cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                                iteration=i, begin_iteration=0,
-                                                end_iteration=num_boost_round,
-                                                evaluation_result_list=evaluation_result_list))
+                if telemetry is not None:
+                    _after_callbacks(i, evaluation_result_list)
+                else:
+                    # trailing resolve: the PREVIOUS iteration's eval
+                    # readback and callbacks run while this iteration's
+                    # device work is already in flight
+                    if pending is not None:
+                        pit, ph = pending
+                        pending = None
+                        drained_it = pit
+                        evaluation_result_list = _resolve_evals(ph)
+                        _after_callbacks(pit, evaluation_result_list)
+                    pending = (i, eval_handle)
+                    if not pipeline or finished:
+                        pit, ph = pending
+                        pending = None
+                        drained_it = pit
+                        evaluation_result_list = _resolve_evals(ph)
+                        _after_callbacks(pit, evaluation_result_list)
             except callback_mod.EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 evaluation_result_list = e.best_score
+                if drained_it < i:
+                    reg = obs.active()
+                    if reg is not None:
+                        # the stop decision arrived one dispatch late:
+                        # iteration i was already trained (and is
+                        # truncated away through best_iteration)
+                        reg.inc("pipeline.delayed_stop_iters")
                 break
             if finished:
                 break
             if ckpt_mgr is not None and ckpt_mgr.due(i):
+                # the pipelined loop drains first: callback state and
+                # eval records must cover iteration i before capture,
+                # exactly as the synchronous order would have them
+                if pending is not None:
+                    try:
+                        pit, ph = pending
+                        pending = None
+                        evaluation_result_list = _resolve_evals(ph)
+                        _after_callbacks(pit, evaluation_result_list)
+                    except callback_mod.EarlyStopException as e:
+                        booster.best_iteration = e.best_iteration + 1
+                        evaluation_result_list = e.best_score
+                        break
                 with obs.span("checkpoint save", phase="checkpoint"):
                     ck_state, ck_model = _checkpoint_capture(booster, cbs)
                     ckpt_mgr.save(i, ck_state, ck_model)
+        # post-loop drain: the final iteration's callbacks (including
+        # the early-stopper's is-last announcement) when the loop ran
+        # to its end with an iteration still in flight
+        if pending is not None:
+            try:
+                pit, ph = pending
+                pending = None
+                evaluation_result_list = _resolve_evals(ph)
+                _after_callbacks(pit, evaluation_result_list)
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score
     finally:
         if telemetry is not None:
             telemetry.close()

@@ -32,7 +32,8 @@ from .spans import (instrument_kernel, span, start_profiler, step_span,
                     stop_profiler)
 from .trace import (Tracer, activate_tracer, active_tracer,
                     deactivate_tracer, install_sync_tracing,
-                    live_array_bytes, uninstall_sync_tracing)
+                    live_array_bytes, sync_attribution,
+                    uninstall_sync_tracing)
 
 __all__ = [
     "MetricsRegistry", "activate", "active", "deactivate",
@@ -42,6 +43,7 @@ __all__ = [
     "start_profiler", "stop_profiler", "TelemetrySession",
     "Tracer", "activate_tracer", "active_tracer", "deactivate_tracer",
     "install_sync_tracing", "uninstall_sync_tracing", "live_array_bytes",
+    "sync_attribution",
 ]
 
 

@@ -59,8 +59,12 @@ SCHEMA_VERSION = 1
 # (trace.* counters, mem.* gauges, coll.* latency/axis accounting), to
 # 6 when the fault-tolerance counters joined (ckpt.saves / ckpt.bytes /
 # ckpt.write_errors / ckpt.resume / ckpt.invalid and fault.fired /
-# fault.<seam> from robust/)
-SCHEMA_MINOR = 6
+# fault.<seam> from robust/), to 7 when the async-pipeline counters
+# joined (pipeline.inflight_fetches / pipeline.delayed_stop_iters /
+# pipeline.donated_bytes under `counters`, the "stop_check" phase
+# timer, and the overlap_share / blocking_syncs_per_iter bench summary
+# fields)
+SCHEMA_MINOR = 7
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -78,7 +82,9 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        # static hot-loop sync inventory (schema minor 3)
                        "hot_loop_syncs",
                        # runtime trace timeline (schema minor 5)
-                       "mem_peak_bytes", "coll_p99_ms")
+                       "mem_peak_bytes", "coll_p99_ms",
+                       # async pipelined iteration (schema minor 7)
+                       "overlap_share", "blocking_syncs_per_iter")
 # optional string-typed bench keys (minor 2): histogram kernel variant;
 # (minor 5): runtime trace output path
 _BENCH_OPTIONAL_STR = ("hist_method", "trace_file")

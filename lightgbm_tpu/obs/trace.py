@@ -34,6 +34,7 @@ microseconds relative to tracer construction (monotonic clock).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 from collections import deque
@@ -76,6 +77,10 @@ class Tracer:
         self.dropped = 0
         self.t0_ns = time.perf_counter_ns()
         self.iteration = -1          # set by TelemetrySession per iter
+        # when set, sync events are attributed to THIS iteration instead
+        # of the current one — trailing fetches (pipelined boosting)
+        # resolve during iteration t+1 but belong to the dispatch at t
+        self.sync_attr_iteration: Optional[int] = None
         self.events_total = 0
 
     # -- recording ------------------------------------------------------
@@ -120,8 +125,10 @@ class Tracer:
             name, args = func, {}
         if nbytes >= 0:
             args["bytes"] = nbytes
+        it = self.iteration if self.sync_attr_iteration is None \
+            else self.sync_attr_iteration
         self._append(("X", name, "sync", t0_ns, max(0, t1_ns - t0_ns),
-                      self.iteration, args))
+                      it, args))
 
     # -- export ---------------------------------------------------------
     def to_perfetto(self) -> Dict[str, Any]:
@@ -191,6 +198,24 @@ def active_tracer() -> Optional[Tracer]:
     return _ACTIVE
 
 
+@contextlib.contextmanager
+def sync_attribution(iteration: Optional[int]):
+    """Attribute sync events recorded in this scope to `iteration`
+    (the DISPATCH iteration of a trailing fetch), not the iteration the
+    fetch happens to resolve in. No-op when no tracer is active or
+    `iteration` is None."""
+    tr = _ACTIVE
+    if tr is None or iteration is None or iteration < 0:
+        yield
+        return
+    prev = tr.sync_attr_iteration
+    tr.sync_attr_iteration = int(iteration)
+    try:
+        yield
+    finally:
+        tr.sync_attr_iteration = prev
+
+
 # -- runtime sync tracing ------------------------------------------------
 # Patches jax.device_get / jax.block_until_ready for the session so
 # every hot-loop host block is timed and attributed. Reuses the
@@ -203,13 +228,22 @@ _SYNC_PATCH: Optional[Tuple[Any, Any]] = None
 
 
 def _payload_bytes(tree: Any) -> int:
-    """Best-effort payload size of a device_get argument."""
+    """Best-effort payload size of a device_get argument. Guarded per
+    leaf: a donated (deleted) buffer raises from `.nbytes`, and one bad
+    leaf must not zero out the whole payload attribution — nor, worse,
+    force a sync by touching buffer contents (metadata only here)."""
     try:
         import jax
         leaves = jax.tree_util.tree_leaves(tree)
-        return int(sum(getattr(x, "nbytes", 0) for x in leaves))
     except Exception:
         return -1
+    total = 0
+    for x in leaves:
+        try:
+            total += int(getattr(x, "nbytes", 0) or 0)
+        except Exception:
+            continue
+    return total
 
 
 def install_sync_tracing() -> bool:

@@ -999,3 +999,20 @@ def set_gh(data: jax.Array, layout: PlaneLayout, grad, hess):
     if gh.shape[1] < data.shape[1]:
         gh = jnp.pad(gh, ((0, 0), (0, data.shape[1] - gh.shape[1])))
     return jax.lax.dynamic_update_slice(data, gh, (layout.grad, 0))
+
+
+def set_gh_packed(data: jax.Array, layout: PlaneLayout, packed_f32):
+    """Write an already quantize-packed (qg << 16 | qh) word plane
+    (bitcast through f32 lanes) into the gradient row and zero the
+    hessian row — the kernels unpack both levels from the one word.
+    With the whole-iteration program's state argument donated
+    (treelearner/fused.py, donate_argnums=1) this update aliases the
+    input planes in place: the next iteration's packed plane lands in
+    the buffer the previous one vacated (double buffering without a
+    copy) while its host-side consumer readbacks are still in flight.
+    """
+    gh = jnp.stack([f32_as_i32(packed_f32),
+                    jnp.zeros_like(packed_f32, dtype=jnp.int32)])
+    if gh.shape[1] < data.shape[1]:
+        gh = jnp.pad(gh, ((0, 0), (0, data.shape[1] - gh.shape[1])))
+    return jax.lax.dynamic_update_slice(data, gh, (layout.grad, 0))

@@ -100,6 +100,48 @@ def quantize_gradients(grad: jax.Array, hess: jax.Array, num_bins: int,
     return qg, qh, gscale.astype(jnp.float32), hscale.astype(jnp.float32)
 
 
+class PrefetchedQuant:
+    """Two-slot dispatch-ahead quantization ring (double buffer).
+
+    The producer (the GBDT host loop) pushes the quantize pass for an
+    upcoming tree as soon as that tree's gradients exist; the consumer
+    (the tree grower) pops it when the tree actually grows. The packed
+    plane for tree t+1 is therefore already building on device while
+    tree t's host-driven growth — and its leaf-renewal readback — is
+    still in flight. Slots are matched by key index AND (grad, hess)
+    object identity, so a consumer can never pair a tree with the wrong
+    stochastic-rounding draw; any mismatch simply falls back to the
+    inline (bit-identical) pass.
+    """
+
+    def __init__(self, depth: int = 2) -> None:
+        self.depth = max(1, int(depth))
+        self.slots: list = []    # (key index, grad, hess, result)
+
+    def __len__(self) -> int:
+        return len(self.slots)
+
+    @property
+    def full(self) -> bool:
+        return len(self.slots) >= self.depth
+
+    def push(self, idx: int, grad, hess, result) -> None:
+        self.slots.append((idx, grad, hess, result))
+
+    def pop_match(self, idx: int, grad, hess):
+        """The prefetched result for (idx, grad, hess), or None. Stale
+        slots (older index or mismatched arrays) are discarded on the
+        way — the ring never reorders the key sequence."""
+        while self.slots:
+            s = self.slots.pop(0)
+            if s[0] == idx and s[1] is grad and s[2] is hess:
+                return s[3]
+        return None
+
+    def clear(self) -> None:
+        self.slots = []
+
+
 def pack_gh(qg: jax.Array, qh: jax.Array) -> jax.Array:
     """[n] int32 packed words: qg in the high 16 bits (sign-carrying),
     qh in the low 16 (always non-negative, so no borrow on unpack)."""

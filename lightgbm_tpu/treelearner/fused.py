@@ -444,7 +444,8 @@ class FusedSerialGrower:
                     static_argnames=("compute_score_update",)))
             self._iter_entry = self._mgr.shared_entry(
                 "fused/train_iter", sig,
-                lambda: jax.jit(self._entry_train_iter, donate_argnums=1))
+                lambda: jax.jit(self._entry_train_iter, donate_argnums=1),
+                donate_argnums=(1,))
             self._sync_entry = self._mgr.shared_entry(
                 "fused/sync_scores", sig,
                 lambda: jax.jit(self._sync_scores))
@@ -1560,8 +1561,7 @@ class FusedSerialGrower:
                 grad_max=gmax, hess_max=hmax)
             qscales = (gs, hs)
             packed = plane.i32_as_f32(Q.pack_gh(qg, qh))
-            data = plane.set_gh(data, Ly, packed,
-                                jnp.zeros_like(packed))
+            data = plane.set_gh_packed(data, Ly, packed)
         else:
             data = plane.set_gh(data, Ly, g, h)
 
@@ -1637,7 +1637,8 @@ class FusedSerialGrower:
         if self._mgr is not None:
             entry = self._mgr.shared_entry(
                 f"fused/train_iters_k{k}", self._compile_signature(),
-                lambda: jax.jit(run, donate_argnums=1))
+                lambda: jax.jit(run, donate_argnums=1),
+                donate_argnums=(1,))
         else:
             entry = jax.jit(run, donate_argnums=1)  # tpulint: jit-ok(manager-disabled fallback branch)
         return instrument_kernel(entry, "fused",

@@ -754,7 +754,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
             self._iter_mc_jit = get_manager().jit_entry(
-                "mc/train_iter", jax.jit(f, donate_argnums=0))
+                "mc/train_iter", jax.jit(f, donate_argnums=0),
+                donate_argnums=(0,))
         args = (data, self._n_per_shard, mask, jnp.float32(shrinkage),
                 jnp.float32(bias))
         if quant:
@@ -798,7 +799,8 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
             self._iters_mc_jit_k[k] = get_manager().jit_entry(
-                f"mc/train_iters_k{k}", jax.jit(f, donate_argnums=0))
+                f"mc/train_iters_k{k}", jax.jit(f, donate_argnums=0),
+                donate_argnums=(0,))
         args = (data, self._n_per_shard, masks, jnp.float32(shrinkage))
         if quant:
             args = args + (self._next_quant_keys(k),)

@@ -157,6 +157,7 @@ class SerialTreeGrower:
         self._quant = bool(config.use_quantized_grad)
         self._qscales = None
         self._quant_tree_idx = 0
+        self._quant_prefetch = Q.PrefetchedQuant()
 
     # ------------------------------------------------------------------
     def _split_packed(self, hist, sum_g, sum_h, num_data, parent_output,
@@ -300,6 +301,23 @@ class SerialTreeGrower:
         return jnp.asarray(r.astype(np.int32))
 
     # ------------------------------------------------------------------
+    def prefetch_quantize(self, grad: jax.Array, hess: jax.Array) -> None:
+        """Dispatch the quantization pass for an upcoming grow() call
+        NOW, up to two trees ahead of consumption (the double buffer in
+        ops/quantize.py PrefetchedQuant). Key indices advance exactly
+        as the inline path's would, so the stochastic-rounding draws
+        are bit-identical; grow() falls back to the inline pass when
+        its arguments don't match a slot. No-op on the f32 path."""
+        if not self._quant or self._quant_prefetch.full:
+            return
+        cfg = self.config
+        idx = self._quant_tree_idx + len(self._quant_prefetch)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7), idx)
+        self._quant_prefetch.push(idx, grad, hess, Q.quantize_gradients(
+            grad, hess, cfg.num_grad_quant_bins, key,
+            cfg.stochastic_rounding))
+
     def grow(self, grad: jax.Array, hess: jax.Array, perm: jax.Array,
              num_data: int) -> Tree:
         """Train one tree (reference SerialTreeLearner::Train,
@@ -323,16 +341,22 @@ class SerialTreeGrower:
         self._qscales = None
         if self._quant:
             # one quantization pass per tree; histograms, the pool, and
-            # subtraction then run in exact int32 level space
+            # subtraction then run in exact int32 level space. The pass
+            # itself usually dispatched ahead (prefetch_quantize) — the
+            # inline fallback is bit-identical (same fold_in key)
             with obs_span("gradient quantization", phase="quantize"):
                 Q.note_requantize(cfg.num_grad_quant_bins)
-                key = jax.random.fold_in(
-                    jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
-                    self._quant_tree_idx)
+                pre = self._quant_prefetch.pop_match(
+                    self._quant_tree_idx, grad, hess)
+                if pre is None:
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(cfg.objective_seed ^ 0x51A7),
+                        self._quant_tree_idx)
+                    pre = Q.quantize_gradients(
+                        grad, hess, cfg.num_grad_quant_bins, key,
+                        cfg.stochastic_rounding)
                 self._quant_tree_idx += 1
-                grad, hess, gs, hs = Q.quantize_gradients(
-                    grad, hess, cfg.num_grad_quant_bins, key,
-                    cfg.stochastic_rounding)
+                grad, hess, gs, hs = pre
                 self._qscales = (gs, hs)
 
         self._cur_perm, self._cur_grad, self._cur_hess = perm, grad, hess

@@ -18,7 +18,10 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   live-array/planar-state gauges, per-op `coll.{op}.ms` latency
   histograms, per-axis `coll.axis.*` counters, the `coll.host_skew` /
   `coll.p99_ms` gauges, and the `trace_file` / `mem_peak_bytes` /
-  `coll_p99_ms` bench summary fields),
+  `coll_p99_ms` bench summary fields; v1.6 adds the fault-tolerance
+  `ckpt.*`/`fault.*` counters; v1.7 adds the async-pipeline
+  `pipeline.*` counters, the `stop_check` phase timer, and the
+  `overlap_share` / `blocking_syncs_per_iter` bench summary fields),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

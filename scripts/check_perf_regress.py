@@ -14,6 +14,10 @@ prediction can't regress predict latency):
 - value            (train wall-clock seconds, the headline number)
 - iter_p50_s       (steady-state per-iteration latency)
 - predict_us_per_row
+- hot_loop_syncs   (static hot-loop sync-point inventory size)
+- blocking_syncs_per_iter (runtime blocking host syncs per streamed
+  iteration — the async-pipeline gate: a change that re-introduces a
+  per-iteration device_get shows up here even when wall time hides it)
 
 Usage:
     python scripts/check_perf_regress.py FRESH.json [--tol 0.10]
@@ -36,7 +40,8 @@ from typing import Any, Dict, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # lower-is-better keys the gate compares
-PERF_KEYS = ("value", "iter_p50_s", "predict_us_per_row")
+PERF_KEYS = ("value", "iter_p50_s", "predict_us_per_row",
+             "hot_loop_syncs", "blocking_syncs_per_iter")
 
 
 def unwrap(doc: Any) -> Optional[Dict[str, Any]]:

@@ -382,3 +382,90 @@ def test_sync_tracing_install_uninstall_balanced():
     finally:
         trace_mod.uninstall_sync_tracing()
     assert jax.device_get is real
+
+
+# -- donated buffers & trailing-fetch attribution (pipelined loop) -------
+
+class _HostileBuffer:
+    """Mimics a donated jax array: metadata access raises (the buffer
+    is deleted), and reading its contents would be a use-after-free."""
+
+    @property
+    def nbytes(self):
+        raise RuntimeError("Array has been deleted")
+
+    def __array__(self):
+        raise AssertionError("payload accounting touched buffer contents")
+
+
+def test_payload_bytes_survives_donated_leaf():
+    from lightgbm_tpu.obs.trace import _payload_bytes
+    # one deleted leaf must not zero out (or blow up) the attribution
+    # of the healthy leaves riding the same device_get
+    healthy = np.zeros(8, dtype=np.float32)
+    assert _payload_bytes([_HostileBuffer(), healthy]) == healthy.nbytes
+    assert _payload_bytes(_HostileBuffer()) == 0
+
+
+def test_traced_device_get_passes_hostile_payload():
+    import jax
+    from lightgbm_tpu.obs import trace as trace_mod
+    tr = Tracer()
+    obs.activate_tracer(tr)
+    assert trace_mod.install_sync_tracing()
+    try:
+        out = jax.device_get(np.arange(4))
+        assert list(out) == [0, 1, 2, 3]
+        # a donated-buffer leaf in the payload must not make the traced
+        # wrapper itself raise (the real device_get decides semantics)
+        with pytest.raises(Exception):
+            jax.device_get(_HostileBuffer())
+    finally:
+        trace_mod.uninstall_sync_tracing()
+        obs.deactivate_tracer(tr)
+    syncs = [ev for ev in tr.buf if ev[2] == "sync"]
+    assert len(syncs) == 2            # the failing call is still traced
+
+
+def test_sync_attribution_rebinds_iteration():
+    tr = Tracer()
+    obs.activate_tracer(tr)       # the scope acts on the ACTIVE tracer
+    try:
+        tr.iteration = 7
+        t0 = tr.now_ns()
+        tr.sync("device_get", None, t0, t0 + 10)
+        with obs.sync_attribution(3):
+            tr.sync("device_get", None, t0, t0 + 10)
+            with obs.sync_attribution(None):   # inner None is a no-op
+                tr.sync("device_get", None, t0, t0 + 10)
+        tr.sync("device_get", None, t0, t0 + 10)
+        assert [ev[5] for ev in tr.buf] == [7, 3, 3, 7]
+        # other event kinds keep the live iteration inside the scope
+        with obs.sync_attribution(3):
+            tr.complete("k", "phase", t0, t0 + 10)
+        assert tr.buf[-1][5] == 7
+    finally:
+        obs.deactivate_tracer(tr)
+
+
+def test_sync_attribution_without_tracer_is_noop():
+    assert obs.active_tracer() is None
+    with obs.sync_attribution(5):
+        pass                               # must not raise
+
+
+def test_instrument_kernel_never_touches_args():
+    from lightgbm_tpu.obs.spans import instrument_kernel
+    reg = MetricsRegistry()
+    obs.activate(reg)
+    try:
+        seen = []
+        wrapped = instrument_kernel(lambda *a: seen.append(a) or 42,
+                                    phase="hist")
+        # donated/hostile buffers flow through untouched: the wrapper
+        # must never read arg metadata or contents (that would sync)
+        assert wrapped(_HostileBuffer(), _HostileBuffer()) == 42
+        assert len(seen[0]) == 2
+        assert reg.counters["kernel.hist.calls"] == 1
+    finally:
+        obs.deactivate(reg)
