@@ -197,6 +197,20 @@ class GBDT:
         self._pipeline = os.environ.get("LGBM_TPU_PIPELINE", "1") != "0"
         self._stop_fetch = None    # in-flight trailing stop-check
         self._stop_pending = None  # drained-but-unconsumed stop verdict
+        # device-side eval toggle: the degraded-mode ladder (rung 2)
+        # clears it to force the host-eval fallback
+        self._device_eval = True
+        # numeric-health sentinels (robust/sentinel.py): per-tree
+        # finiteness/overflow checks whose verdicts ride the existing
+        # trailing fetches
+        self._sentinel = None
+        self._sentinel_deferred: list = []  # (iteration, queued tree)
+        if config.numeric_sentinels:
+            from ..robust.sentinel import NumericSentinel
+            self._sentinel = NumericSentinel(
+                overflow_limit=config.sentinel_overflow_limit,
+                max_trips=config.sentinel_max_trips)
+        self._poison_next = None   # train.iteration:nan/overflow drill
         self.train_score = _ScoreState(train_data, self.num_tree_per_iteration)
         self.class_need_train = [True] * self.num_tree_per_iteration
 
@@ -308,6 +322,12 @@ class GBDT:
                 t.tree_arrays = ta
         self._pq_trees = []
         self._pq_masks = []
+        if self._sentinel_deferred:
+            # queued iterations now have device arrays: dispatch the
+            # health checks that were deferred to keep the batch intact
+            deferred, self._sentinel_deferred = self._sentinel_deferred, []
+            for it, t in deferred:
+                self._sentinel_check_trees([t], iteration=it)
 
     def _invalidate_fused_state(self) -> None:
         """Call after any direct train_score mutation (rollback, refit,
@@ -360,11 +380,13 @@ class GBDT:
             if not (self._fused_persist and self._fused is not None):
                 with obs_span("gbdt/boosting (gradients)", phase="boost"):
                     self._boosting()
+                self._apply_grad_poison()
         else:
             g = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, self.num_data))
             h = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, self.num_data))
             self._grad, self._hess = g, h
 
+        self._sentinel_check_grads()
         self._bagging(self.iter)
 
         if self._fused is not None:
@@ -420,13 +442,108 @@ class GBDT:
             self.models.append(new_tree)
 
         if not should_continue:
+            if self._quarantine_degenerate_iter(k):
+                return False
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             if len(self.models) > k:
                 del self.models[-k:]
             return True
+        self._sentinel_check_trees(self.models[-k:])
         self.iter += 1
         return False
+
+    def _apply_grad_poison(self) -> None:
+        """``train.iteration:nan``/``overflow`` drill: poison one
+        gradient element so the corruption propagates through histogram
+        accumulation, split finding, and leaf values exactly like real
+        divergence would (the sentinel must catch it downstream)."""
+        mode, self._poison_next = self._poison_next, None
+        if mode is None or self._grad is None:
+            return
+        bad = jnp.float32(float("nan") if mode == "nan" else 2e30)
+        self._grad = self._grad.at[0, 0].set(bad)
+        log.warning("fault injection: poisoned the gradient plane with %s "
+                    "at iteration %d", mode, self.iter)
+
+    def _sentinel_check_grads(self) -> None:
+        """Gradient/hessian-plane health checks: async device reductions
+        whose verdicts ride the trailing fetches like the leaf checks.
+        The persistent fused path computes gradients in-program and is
+        covered by its leaf-value checks instead."""
+        if self._sentinel is None or self._grad is None:
+            return
+        with obs_span("sentinel health check (dispatch)", phase="sentinel"):
+            self._sentinel.dispatch([self._grad, self._hess], self.iter)
+
+    def _quarantine_degenerate_iter(self, k: int) -> bool:
+        """An all-degenerate iteration is ALSO the exact signature of a
+        poisoned gradient plane: NaN gains reject every split. Before
+        declaring convergence, resolve the in-flight sentinel verdicts;
+        when THIS iteration's gradient check tripped, discard its trees
+        as a quarantine and keep training — the next iteration
+        recomputes clean gradients from the untouched scores."""
+        if self._sentinel is None:
+            return False
+        self.sentinel_drain()
+        trips = self._sentinel.pop_trips()
+        mine = [t for t in trips if t[0] == self.iter]
+        others = [t for t in trips if t[0] != self.iter]
+        if others:
+            # earlier iterations' trips go back to the recovery policy
+            self._sentinel._trips_out = others + self._sentinel._trips_out
+        if not mine:
+            return False
+        del self.models[-k:]
+        if not self.models:
+            # iteration 0's boost_from_average constant was folded into
+            # the scores before its trees were discarded
+            self._rebuild_scores()
+        from .. import obs
+        reg = obs.active()
+        if reg is not None:
+            reg.inc("health.quarantined", k)
+        log.warning(
+            "numeric sentinel: quarantined the tree(s) of iteration %d "
+            "(%s gradient plane); training continues", self.iter,
+            mine[0][1])
+        return True
+
+    def _sentinel_check_trees(self, trees, iteration: Optional[int] = None
+                              ) -> None:
+        """Numeric-health checks on this iteration's new trees
+        (robust/sentinel.py). Device-resident leaf values get an async
+        [nonfinite, overflow] reduction whose tiny verdict rides the
+        NEXT trailing fetch; host trees are judged immediately. Queued
+        persistent iterations are deferred to the queue flush — forcing
+        the resolver here would defeat the dispatch batch. Costs zero
+        extra blocking syncs either way."""
+        sent = self._sentinel
+        if sent is None:
+            return
+        if iteration is None:
+            iteration = self.iter
+        from ..treelearner.fused import PendingTree
+        arrays: list = []
+        with obs_span("sentinel health check (dispatch)", phase="sentinel"):
+            for t in trees:
+                if isinstance(t, PendingTree) and t._tree is None:
+                    if t._ta is None and t.batch is None \
+                            and t.resolver is not None:
+                        self._sentinel_deferred.append((iteration, t))
+                        continue
+                    stacked = t._ta is None and t.batch is not None \
+                        and t.batch._host is None
+                    src = t.batch.stack if stacked else t.tree_arrays
+                    arrays.append(src["leaf_value"][t.index] if stacked
+                                  else src["leaf_value"])
+                else:
+                    tree = t._tree if isinstance(t, PendingTree) else t
+                    arrays.append(np.asarray(
+                        tree.leaf_value[:max(tree.num_leaves, 1)],
+                        dtype=np.float32))
+            if arrays:
+                sent.dispatch(arrays, iteration)
 
     def _train_one_iter_persistent(self, init_scores) -> bool:
         """Persistent fused path: the ENTIRE boosting iteration
@@ -468,6 +585,7 @@ class GBDT:
         if abs(init_scores[0]) > K_EPSILON:
             pending.add_bias(init_scores[0])
         self.models.append(pending)
+        self._sentinel_check_trees(self.models[-1:])
         self.iter += 1
         if self.iter % self._fused_check_every == 0 and \
                 self._periodic_stop_check(self.models[-1:]):
@@ -495,6 +613,7 @@ class GBDT:
             if abs(init_scores[c]) > K_EPSILON:
                 pending.add_bias(init_scores[c])
             self.models.append(pending)
+        self._sentinel_check_trees(self.models[-k:])
         self.iter += 1
         # deferred no-more-splits detection: syncing every iteration
         # would cost a tunnel round trip, so check periodically and
@@ -583,16 +702,24 @@ class GBDT:
         refs, counts, disp_iter, disp_trace_iter = self._stop_fetch
         self._stop_fetch = None
         counts = list(counts)
-        if refs:
+        sent = self._sentinel
+        s_pending = sent.take_pending() if sent is not None else []
+        if refs or s_pending:
+            from ..robust.watchdog import watch_phase
             with obs_span("trailing stop-check (readback)",
                           phase="stop_check"), \
-                    obs.sync_attribution(disp_trace_iter):
+                    obs.sync_attribution(disp_trace_iter), \
+                    watch_phase("readback:stop check"):
                 # tpulint: sync-ok(trailing-fetch: resolves the readback dispatched one check period earlier, already host-resident in steady state)
-                vals = jax.device_get([r for _, r in refs])
+                vals = jax.device_get([r for _, r in refs] +
+                                      [r for _, r in s_pending])
             for (t, _), v in zip(refs, vals):
                 if t._n_leaves_host is None:
                     t._n_leaves_host = int(v)
                 counts.append(int(v))
+            if s_pending:
+                # sentinel verdicts ride the same batched fetch
+                sent.resolve(s_pending, vals[len(refs):])
         stop = bool(counts) and all(v <= 1 for v in counts)
         if stop and self.iter > disp_iter:
             reg = obs.active()
@@ -774,6 +901,96 @@ class GBDT:
         self.iter -= 1
 
     # ------------------------------------------------------------------
+    # numeric-health quarantine (robust/sentinel.py)
+    # ------------------------------------------------------------------
+    def quarantine_iter(self, iteration: int) -> bool:
+        """Discard the tree(s) of one absolute (0-based) iteration that
+        a numeric sentinel flagged, then REBUILD every score state from
+        the surviving trees. Rollback-by-subtraction would re-touch the
+        poisoned leaf values (NaN - NaN = NaN) and contaminate the
+        scores permanently; the rebuild never reads them."""
+        k = self.num_tree_per_iteration
+        idx = iteration - self.num_init_iteration
+        if idx < 0 or (idx + 1) * k > len(self.models):
+            return False
+        self._pred_revision = getattr(self, "_pred_revision", 0) + 1
+        self._flush_persistent_queue()
+        self._materialize_models()
+        self._drain_stop_check()
+        del self.models[idx * k:(idx + 1) * k]
+        self._on_quarantine(idx)
+        self.iter -= 1
+        # the persistent planar state carries the poisoned scores; it
+        # is rebuilt lazily from the fresh train_score next iteration
+        self._fused_state = None
+        self._score_dirty = False
+        self._rebuild_scores()
+        from .. import obs
+        reg = obs.active()
+        if reg is not None:
+            reg.inc("health.quarantined", k)
+        return True
+
+    def _on_quarantine(self, idx: int) -> None:
+        """Boosting-mode hook: drop per-iteration side state for the
+        quarantined (relative) iteration ``idx``."""
+
+    def _rebuild_scores(self) -> None:
+        """Recompute train/valid scores from scratch off the surviving
+        forest. Fresh _ScoreState re-applies init scores; the
+        boost_from_average constant needs no special casing because it
+        is folded into the first iteration's trees (add_bias / the
+        constant-tree leaf)."""
+        k = self.num_tree_per_iteration
+        miss = self.tree_learner.feature_miss_bin
+        self.train_score = _ScoreState(self.train_data, k)
+        self.valid_score = [_ScoreState(vs.dataset, k)
+                            for vs in self.valid_score]
+        for i, tree in enumerate(self.models):
+            self.train_score.add_tree(tree, i % k, miss)
+            for vs in self.valid_score:
+                vs.add_tree(tree, i % k, miss)
+
+    def sentinel_drain(self) -> None:
+        """Force-resolve in-flight sentinel verdicts. End-of-training
+        and pre-rollback only — in steady state verdicts ride the
+        trailing fetches instead."""
+        sent = self._sentinel
+        if sent is None:
+            return
+        if self._sentinel_deferred:
+            self._flush_persistent_queue()
+        pending = sent.take_pending()
+        if pending:
+            # tpulint: sync-ok(sentinel drain: end-of-training/rollback only, one batched fetch)
+            vals = jax.device_get([r for _, r in pending])
+            sent.resolve(pending, vals)
+
+    def process_sentinel_trips(self) -> bool:
+        """Quarantine every iteration a sentinel flagged since the last
+        call. Returns True when accumulated trips reached the
+        escalation threshold (the engine then rolls back to the last
+        checkpoint and steps down the degraded-mode ladder)."""
+        sent = self._sentinel
+        if sent is None:
+            return False
+        flagged: Dict[int, str] = {}
+        for iteration, kind in sent.pop_trips():
+            flagged.setdefault(iteration, kind)
+        # highest iteration first: quarantining an iteration shifts
+        # every LATER iteration's position in self.models, never an
+        # earlier one's
+        for iteration in sorted(flagged, reverse=True):
+            if self.quarantine_iter(iteration):
+                log.warning(
+                    "numeric sentinel: quarantined the tree(s) of "
+                    "iteration %d (%s detected in leaf values); "
+                    "training continues on the healthy forest",
+                    iteration, flagged[iteration])
+        sent.poll_quant_tripwire()
+        return sent.trips >= sent.max_trips
+
+    # ------------------------------------------------------------------
     def _renew_tree_output(self, tree: Tree, class_id: int) -> None:
         """Objective-specific leaf refit (reference
         SerialTreeLearner::RenewTreeOutput, serial_tree_learner.cpp:661;
@@ -839,7 +1056,7 @@ class GBDT:
         div = 1.0
         if self.average_output and self.current_iteration > 0:
             div = float(self.current_iteration)
-        use_device = (div == 1.0 and os.environ.get(
+        use_device = (div == 1.0 and self._device_eval and os.environ.get(
             "LGBM_TPU_DEVICE_EVAL", "1") != "0")
 
         def eval_set(ds_name, metrics, score):
@@ -888,15 +1105,24 @@ class GBDT:
         engine loop the handle is one iteration old, so the scalars are
         already host-resident and the fetch does not block."""
         from .. import obs
+        from ..robust.watchdog import watch_phase
         out, dev_slots, disp_iter = handle
-        if dev_slots:
+        sent = self._sentinel
+        s_pending = sent.take_pending() if sent is not None else []
+        if dev_slots or s_pending:
             reg = obs.active()
-            with obs.sync_attribution(disp_iter):
+            with obs.sync_attribution(disp_iter), \
+                    watch_phase("readback:eval scalars"):
                 # tpulint: sync-ok(trailing-fetch: batched eval scalars dispatched an iteration earlier; one transfer per eval)
-                vals = jax.device_get([v for _, v in dev_slots])
+                vals = jax.device_get([v for _, v in dev_slots] +
+                                      [r for _, r in s_pending])
             for (idx, _), v in zip(dev_slots, vals):
                 out[idx][2] = float(v)
-            if reg is not None:
+            if s_pending:
+                # sentinel verdicts ride the same batched fetch — zero
+                # extra blocking syncs for numeric-health checks
+                sent.resolve(s_pending, vals[len(dev_slots):])
+            if reg is not None and dev_slots:
                 reg.inc("eval.device_scalars", len(dev_slots))
         return [tuple(t) for t in out]
 
@@ -1229,6 +1455,14 @@ class GBDT:
         # (absent in older checkpoints -> no verdict, same as before)
         self._stop_fetch = None
         self._stop_pending = True if state.get("stop_pending") else None
+        # a mid-run restore (watchdog auto-resume, sentinel rollback)
+        # lands on a LIVE booster: queued iterations and deferred
+        # sentinel work belong to the abandoned timeline
+        self._pq_trees = []
+        self._pq_masks = []
+        self._sentinel_deferred = []
+        if self._sentinel is not None:
+            self._sentinel.drop_pending()
         self.models = list(parse_tree_blocks(model_text))
         # the text format drops bin-space fields; train-time score
         # surgery (DART drop/normalize, rollback) traverses in bin
@@ -1365,6 +1599,12 @@ class DART(GBDT):
             self.tree_weight = [float(w) for w in d["tree_weight"]]
             self.sum_weight = float(d["sum_weight"])
             self.drop_index = []
+
+    def _on_quarantine(self, idx: int) -> None:
+        # keep the dropout weights aligned with the surviving forest
+        if idx < len(self.tree_weight):
+            self.sum_weight -= self.tree_weight[idx]
+            del self.tree_weight[idx]
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is None or hessians is None:

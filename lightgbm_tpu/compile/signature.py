@@ -57,6 +57,11 @@ _IGNORED_CONFIG_FIELDS = frozenset({
     # any traced program — resuming with a different checkpoint_dir
     # must hit the same executables
     "checkpoint_dir", "checkpoint_interval", "checkpoint_keep",
+    # self-healing: the watchdog is host-side, and the sentinel takes
+    # its overflow limit as a runtime scalar operand — toggling either
+    # must hit the same executables (zero new compiles on a warm store)
+    "hang_timeout", "auto_resume", "auto_resume_attempts",
+    "numeric_sentinels", "sentinel_overflow_limit", "sentinel_max_trips",
 })
 
 

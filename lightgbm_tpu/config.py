@@ -132,6 +132,11 @@ _ALIASES: Dict[str, str] = {
     "checkpoint_freq": "checkpoint_interval",
     "ckpt_interval": "checkpoint_interval",
     "ckpt_keep": "checkpoint_keep",
+    "watchdog_timeout": "hang_timeout",
+    "hang_timeout_s": "hang_timeout",
+    "auto_restart": "auto_resume",
+    "sentinels": "numeric_sentinels",
+    "numeric_health_checks": "numeric_sentinels",
     # dataset
     "max_bins": "max_bin",
     "subsample_for_bin": "bin_construct_sample_cnt",
@@ -427,6 +432,24 @@ class Config:
     checkpoint_interval: int = 50
     # retain the newest k checkpoint files
     checkpoint_keep: int = 2
+    # hang watchdog deadline in seconds: if one boosting iteration,
+    # collective dispatch, or trailing readback blocks the host longer
+    # than this, the watchdog flushes the trace, dumps thread stacks,
+    # and classifies the stall. 0 = watchdog off.
+    hang_timeout: float = 0.0
+    # on a watchdog trip (or exhausted sentinel retries), re-enter
+    # training from the last checkpoint instead of aborting
+    auto_resume: bool = False
+    # maximum automatic re-entries per train() call
+    auto_resume_attempts: int = 3
+    # device-side numeric-health sentinels on new trees' leaf values;
+    # verdicts ride the existing trailing fetches (no extra syncs)
+    numeric_sentinels: bool = False
+    # |leaf value| above this trips the overflow sentinel
+    sentinel_overflow_limit: float = 1e30
+    # sentinel trips before escalating from single-tree quarantine to
+    # checkpoint rollback + degraded-mode ladder
+    sentinel_max_trips: int = 2
 
     # --- dataset ---
     max_bin: int = 255
@@ -655,6 +678,11 @@ class Config:
         if self.checkpoint_dir:
             self.checkpoint_interval = max(self.checkpoint_interval, 1)
             self.checkpoint_keep = max(self.checkpoint_keep, 1)
+        self.hang_timeout = max(self.hang_timeout, 0.0)
+        self.auto_resume_attempts = max(self.auto_resume_attempts, 1)
+        self.sentinel_max_trips = max(self.sentinel_max_trips, 1)
+        if self.sentinel_overflow_limit <= 0:
+            self.sentinel_overflow_limit = 1e30
         log.set_verbosity(self.verbosity)
 
     def to_params_string(self) -> str:
@@ -666,7 +694,9 @@ class Config:
         # model texts (the chaos tests compare them byte-for-byte), and
         # where the checkpoint lives is operational, not model, state
         skip = ("extra", "checkpoint_dir", "checkpoint_interval",
-                "checkpoint_keep")
+                "checkpoint_keep", "hang_timeout", "auto_resume",
+                "auto_resume_attempts", "numeric_sentinels",
+                "sentinel_overflow_limit", "sentinel_max_trips")
         for f in dataclasses.fields(self):
             if f.name in skip:
                 continue

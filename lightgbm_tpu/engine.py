@@ -320,103 +320,213 @@ def train(params: Dict[str, Any], train_set: Dataset,
         return evals
 
     def _after_callbacks(it: int, evals) -> None:
-        for cb in callbacks_after:
-            cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=it, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=evals))
-    try:
-        for i in range(start_iteration, num_boost_round):
-            check_fault("train.iteration", index=i)
-            if telemetry is not None:
-                telemetry.begin_iteration(i)
-            with obs.span("before-iteration callbacks", phase="callbacks"):
-                for cb in callbacks_before:
-                    cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                                iteration=i,
-                                                begin_iteration=0,
-                                                end_iteration=num_boost_round,
-                                                evaluation_result_list=None))
-            with obs.span("boosting iteration (device dispatch)",
-                          phase="update"):
-                finished = booster.update(fobj=fobj)
+        with watch_phase("host-callback:after"):
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                            iteration=it, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=evals))
 
-            with obs.span("metric evaluation", phase="eval"):
-                eval_handle = (
-                    booster._gbdt.begin_eval_at_iter()
-                    if valid_contain_train or booster.name_valid_sets
-                    else None)
-            if telemetry is not None:
-                evaluation_result_list = _resolve_evals(eval_handle)
-                eval_handle = None
-                _telemetry_end_iteration(telemetry, booster, i,
-                                         evaluation_result_list)
-            drained_it = i
-            try:
+    # self-healing (docs/ROBUSTNESS.md): a hang watchdog arms a deadman
+    # timer over the loop; numeric-sentinel verdicts ride the trailing
+    # fetches; the recovery policy below quarantines bad trees, rolls
+    # back to the last checkpoint, and steps down the degraded-mode
+    # ladder instead of hanging forever or training garbage
+    from .robust.sentinel import apply_degraded_rung
+    from .robust.watchdog import (HangTimeout, Watchdog, activate_watchdog,
+                                  deactivate_watchdog, watch_phase)
+    wd = None
+    if cfg.hang_timeout > 0:
+        wd = Watchdog(cfg.hang_timeout,
+                      trace_path=(cfg.trace_file + ".watchdog.json"
+                                  if cfg.trace_file
+                                  else "watchdog_trace.json"),
+                      # the first iterations block on whole-program
+                      # compiles; a short timeout must not call that a
+                      # hang (and there is no checkpoint to resume from
+                      # yet)
+                      warmup_grace_s=max(60.0, 4 * cfg.hang_timeout))
+        activate_watchdog(wd)
+        wd.start()
+    resume_attempts = 0
+    degraded_rung = 0
+
+    def _restore_latest() -> bool:
+        """Roll the LIVE booster back to the newest checkpoint; updates
+        start_iteration for loop re-entry. In-flight eval handles are
+        dropped — they belong to the abandoned timeline."""
+        nonlocal start_iteration, pending
+        if ckpt_mgr is None:
+            return False
+        resumed = ckpt_mgr.load_latest()
+        if resumed is None:
+            return False
+        pending = None
+        it, ck_state, ck_model = resumed
+        _checkpoint_restore(booster, cbs, ck_state, ck_model)
+        start_iteration = it + 1
+        return True
+    try:
+      while True:
+        restart = False
+        try:
+            for i in range(start_iteration, num_boost_round):
+                if wd is not None:
+                    wd.beat(i)
+                    wd.check()
+                spec = check_fault("train.iteration", index=i)
+                if spec is not None and spec.mode in ("nan", "overflow"):
+                    # drill: the next gradient plane is poisoned; the
+                    # numeric sentinels must catch the divergence
+                    booster._gbdt._poison_next = spec.mode
                 if telemetry is not None:
-                    _after_callbacks(i, evaluation_result_list)
-                else:
-                    # trailing resolve: the PREVIOUS iteration's eval
-                    # readback and callbacks run while this iteration's
-                    # device work is already in flight
-                    if pending is not None:
-                        pit, ph = pending
-                        pending = None
-                        drained_it = pit
-                        evaluation_result_list = _resolve_evals(ph)
-                        _after_callbacks(pit, evaluation_result_list)
-                    pending = (i, eval_handle)
-                    if not pipeline or finished:
-                        pit, ph = pending
-                        pending = None
-                        drained_it = pit
-                        evaluation_result_list = _resolve_evals(ph)
-                        _after_callbacks(pit, evaluation_result_list)
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                evaluation_result_list = e.best_score
-                if drained_it < i:
-                    reg = obs.active()
-                    if reg is not None:
-                        # the stop decision arrived one dispatch late:
-                        # iteration i was already trained (and is
-                        # truncated away through best_iteration)
-                        reg.inc("pipeline.delayed_stop_iters")
-                break
-            if finished:
-                break
-            if ckpt_mgr is not None and ckpt_mgr.due(i):
-                # the pipelined loop drains first: callback state and
-                # eval records must cover iteration i before capture,
-                # exactly as the synchronous order would have them
-                if pending is not None:
-                    try:
-                        pit, ph = pending
-                        pending = None
-                        evaluation_result_list = _resolve_evals(ph)
-                        _after_callbacks(pit, evaluation_result_list)
-                    except callback_mod.EarlyStopException as e:
-                        booster.best_iteration = e.best_iteration + 1
-                        evaluation_result_list = e.best_score
+                    telemetry.begin_iteration(i)
+                with obs.span("before-iteration callbacks",
+                              phase="callbacks"), \
+                        watch_phase("host-callback:before"):
+                    for cb in callbacks_before:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params, iteration=i,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=None))
+                with obs.span("boosting iteration (device dispatch)",
+                              phase="update"), \
+                        watch_phase("dispatch:update"):
+                    finished = booster.update(fobj=fobj)
+
+                with obs.span("metric evaluation", phase="eval"):
+                    eval_handle = (
+                        booster._gbdt.begin_eval_at_iter()
+                        if valid_contain_train or booster.name_valid_sets
+                        else None)
+                if telemetry is not None:
+                    evaluation_result_list = _resolve_evals(eval_handle)
+                    eval_handle = None
+                    _telemetry_end_iteration(telemetry, booster, i,
+                                             evaluation_result_list)
+                drained_it = i
+                try:
+                    if telemetry is not None:
+                        _after_callbacks(i, evaluation_result_list)
+                    else:
+                        # trailing resolve: the PREVIOUS iteration's eval
+                        # readback and callbacks run while this iteration's
+                        # device work is already in flight
+                        if pending is not None:
+                            pit, ph = pending
+                            pending = None
+                            drained_it = pit
+                            evaluation_result_list = _resolve_evals(ph)
+                            _after_callbacks(pit, evaluation_result_list)
+                        pending = (i, eval_handle)
+                        if not pipeline or finished:
+                            pit, ph = pending
+                            pending = None
+                            drained_it = pit
+                            evaluation_result_list = _resolve_evals(ph)
+                            _after_callbacks(pit, evaluation_result_list)
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    evaluation_result_list = e.best_score
+                    if drained_it < i:
+                        reg = obs.active()
+                        if reg is not None:
+                            # the stop decision arrived one dispatch late:
+                            # iteration i was already trained (and is
+                            # truncated away through best_iteration)
+                            reg.inc("pipeline.delayed_stop_iters")
+                    break
+                sent = booster._gbdt._sentinel
+                if sent is not None \
+                        and booster._gbdt.process_sentinel_trips():
+                    # repeated numeric trips: quarantine was not enough,
+                    # so roll back to the last checkpoint and give up
+                    # one optimization rung per recovery epoch
+                    rung = apply_degraded_rung(booster._gbdt,
+                                               degraded_rung)
+                    if rung is not None:
+                        degraded_rung += 1
+                    if _restore_latest():
+                        reg = obs.active()
+                        if reg is not None:
+                            reg.inc("health.rollbacks")
+                        sent.drop_pending()
+                        sent.reset_trips()
+                        log.warning(
+                            "sentinel: rolled back to iteration %d after "
+                            "%d numeric-health trips", start_iteration,
+                            sent.total_trips)
+                        restart = True
                         break
-                with obs.span("checkpoint save", phase="checkpoint"):
-                    ck_state, ck_model = _checkpoint_capture(booster, cbs)
-                    ckpt_mgr.save(i, ck_state, ck_model)
-        # post-loop drain: the final iteration's callbacks (including
-        # the early-stopper's is-last announcement) when the loop ran
-        # to its end with an iteration still in flight
-        if pending is not None:
-            try:
-                pit, ph = pending
-                pending = None
-                evaluation_result_list = _resolve_evals(ph)
-                _after_callbacks(pit, evaluation_result_list)
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                evaluation_result_list = e.best_score
+                    # no checkpoint to return to: the offending trees
+                    # are already quarantined, keep training degraded
+                    sent.reset_trips()
+                if finished:
+                    break
+                if ckpt_mgr is not None and ckpt_mgr.due(i):
+                    # the pipelined loop drains first: callback state and
+                    # eval records must cover iteration i before capture,
+                    # exactly as the synchronous order would have them
+                    if pending is not None:
+                        try:
+                            pit, ph = pending
+                            pending = None
+                            evaluation_result_list = _resolve_evals(ph)
+                            _after_callbacks(pit, evaluation_result_list)
+                        except callback_mod.EarlyStopException as e:
+                            booster.best_iteration = e.best_iteration + 1
+                            evaluation_result_list = e.best_score
+                            break
+                    with obs.span("checkpoint save", phase="checkpoint"):
+                        ck_state, ck_model = _checkpoint_capture(booster, cbs)
+                        ckpt_mgr.save(i, ck_state, ck_model)
+            if restart:
+                continue
+            # post-loop drain: the final iteration's callbacks (including
+            # the early-stopper's is-last announcement) when the loop ran
+            # to its end with an iteration still in flight
+            if pending is not None:
+                try:
+                    pit, ph = pending
+                    pending = None
+                    evaluation_result_list = _resolve_evals(ph)
+                    _after_callbacks(pit, evaluation_result_list)
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    evaluation_result_list = e.best_score
+            break
+        except HangTimeout:
+            resume_attempts += 1
+            if not cfg.auto_resume \
+                    or resume_attempts > cfg.auto_resume_attempts \
+                    or not _restore_latest():
+                # no checkpoint (or attempts exhausted): surface the
+                # watchdog's classified, actionable diagnosis
+                raise
+            if booster._gbdt._sentinel is not None:
+                booster._gbdt._sentinel.drop_pending()
+            if wd is not None:
+                wd.clear()
+            reg = obs.active()
+            if reg is not None:
+                reg.inc("watchdog.auto_resume")
+            log.warning(
+                "watchdog: auto-resuming from iteration %d after a "
+                "detected hang (attempt %d/%d)", start_iteration,
+                resume_attempts, cfg.auto_resume_attempts)
     finally:
+        if wd is not None:
+            deactivate_watchdog(wd)
+            wd.stop()
         if telemetry is not None:
             telemetry.close()
+
+    # resolve any sentinel verdicts still in flight so a trip on the
+    # final trees still quarantines them before the model is finalized
+    if getattr(booster._gbdt, "_sentinel", None) is not None:
+        booster._gbdt.sentinel_drain()
+        booster._gbdt.process_sentinel_trips()
 
     # fused path trains blind between periodic stop checks; drop any
     # trailing all-degenerate iterations it may have accumulated

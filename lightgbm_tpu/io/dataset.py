@@ -50,6 +50,19 @@ def _csc_col(data, f: int):
     return data.indices[start:end], data.data[start:end]
 
 
+def _reject_inf_feature(vals: np.ndarray, names, f: int) -> None:
+    """±Inf feature values corrupt bin boundaries and flow silently into
+    histogram sums; reject at construction, naming the column. NaN stays
+    legal — it is the missing-value representation (reference
+    BinMapper::ValueToBin routes NaN through the NA bin)."""
+    inf = np.isinf(vals)
+    if inf.any():
+        log.fatal(
+            "Feature '%s' (column %d) contains %d infinite value(s); "
+            "replace them with NaN (missing) or clip to a finite range",
+            names[f] if f < len(names) else str(f), f, int(inf.sum()))
+
+
 class Metadata:
     """Per-row training metadata (reference: src/io/metadata.cpp,
     include/LightGBM/dataset.h:40-248): label, weights, query boundaries,
@@ -69,6 +82,14 @@ class Metadata:
         label = np.asarray(label, dtype=np.float32).reshape(-1)
         if len(label) != self.num_data:
             log.fatal("Length of label (%d) != num_data (%d)", len(label), self.num_data)
+        bad = ~np.isfinite(label)
+        if bad.any():
+            # reference metadata.cpp refuses NaN labels at load; a NaN
+            # here poisons every gradient silently
+            log.fatal(
+                "Label contains %d non-finite value(s) (NaN/Inf), first "
+                "at row %d; clean the label column before constructing "
+                "the Dataset", int(bad.sum()), int(np.flatnonzero(bad)[0]))
         self.label = label
 
     def set_weights(self, weights: Optional[np.ndarray]) -> None:
@@ -87,6 +108,12 @@ class Metadata:
         init_score = np.asarray(init_score, dtype=np.float64).reshape(-1, order="F")
         if len(init_score) % self.num_data != 0:
             log.fatal("Length of init_score is not a multiple of num_data")
+        bad = ~np.isfinite(init_score)
+        if bad.any():
+            log.fatal(
+                "init_score contains %d non-finite value(s) (NaN/Inf), "
+                "first at position %d; scores must be finite",
+                int(bad.sum()), int(np.flatnonzero(bad)[0]))
         self.init_score = init_score
 
     def set_query(self, group: Optional[np.ndarray]) -> None:
@@ -400,9 +427,11 @@ class BinnedDataset:
             f = self.real_feature_index[i]
             if sparse:
                 idx, vals = _csc_col(data, f)
-                return idx, mappers[i].values_to_bins(
-                    np.asarray(vals, dtype=np.float64))
+                vals = np.asarray(vals, dtype=np.float64)
+                _reject_inf_feature(vals, self.feature_names, f)
+                return idx, mappers[i].values_to_bins(vals)
             col = np.asarray(data[:, f], dtype=np.float64)
+            _reject_inf_feature(col, self.feature_names, f)
             return None, mappers[i].values_to_bins(col)
 
         if bt is None or bt.is_trivial:

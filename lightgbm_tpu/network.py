@@ -55,27 +55,36 @@ def collective_span(op: str, nbytes: int = 0, axis: str = ""):
     from .obs import registry as _registry
     from .obs import trace as _trace
     from .robust.faultinject import check_fault
-    check_fault("collective.dispatch")
-    reg = _registry.active()
-    tr = _trace.active_tracer()
-    if reg is None and tr is None:
-        yield
-        return
-    tr_t0 = tr.now_ns() if tr is not None else 0
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        if reg is not None:
-            reg.record_collective(op, nbytes, dt, axis=axis)
-        if tr is not None:
-            args = {"bytes": int(nbytes)}
-            if axis:
-                args["axis"] = axis
-            tr.complete(op, "collective", tr_t0, tr.now_ns(), args)
-        log.trace("collective %s: %d bytes, %.3f ms host", op, nbytes,
-                  dt * 1e3)
+    from .robust.watchdog import active_watchdog
+    wd = active_watchdog()
+    with contextlib.ExitStack() as stack:
+        if wd is not None:
+            # watchdog phase marker: a hang inside the dispatch (or an
+            # injected one at the fault seam below) classifies as a
+            # "collective" stall, and leaving the span is a cooperative
+            # check point for a pending trip
+            stack.enter_context(wd.phase(f"collective:{op}"))
+        check_fault("collective.dispatch")
+        reg = _registry.active()
+        tr = _trace.active_tracer()
+        if reg is None and tr is None:
+            yield
+            return
+        tr_t0 = tr.now_ns() if tr is not None else 0
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if reg is not None:
+                reg.record_collective(op, nbytes, dt, axis=axis)
+            if tr is not None:
+                args = {"bytes": int(nbytes)}
+                if axis:
+                    args["axis"] = axis
+                tr.complete(op, "collective", tr_t0, tr.now_ns(), args)
+            log.trace("collective %s: %d bytes, %.3f ms host", op, nbytes,
+                      dt * 1e3)
 
 
 def straggler_skew(seconds: float) -> float:
@@ -88,10 +97,21 @@ def straggler_skew(seconds: float) -> float:
     NOTE: this is itself a host barrier (allgather), so it only runs on
     the metrics/trace path, never in the disabled-telemetry loop.
     """
+    return straggler_stats(seconds)[0]
+
+
+def straggler_stats(seconds: float) -> Tuple[float, int]:
+    """(skew, slowest_rank) for one iteration over the same allgather
+    as :func:`straggler_skew`: the rank index of the host that took the
+    longest lets the watchdog NAME the straggler at trip time instead
+    of reporting an anonymous "hang" (collectives cannot run inside the
+    watchdog thread — the mesh may be the thing that hung — so this is
+    sampled on the telemetry path and read back from the
+    ``coll.slowest_rank`` gauge)."""
     try:
         import jax
         if jax.process_count() <= 1:
-            return 0.0
+            return 0.0, 0
         import numpy as np
         from jax.experimental import multihost_utils
         times = np.asarray(
@@ -99,10 +119,11 @@ def straggler_skew(seconds: float) -> float:
             dtype=np.float64).ravel()
         mean = float(times.mean())
         if mean <= 0.0:
-            return 0.0
-        return float((times.max() - times.min()) / mean)
+            return 0.0, 0
+        return (float((times.max() - times.min()) / mean),
+                int(times.argmax()))
     except Exception:
-        return 0.0
+        return 0.0, 0
 
 
 def parse_machine_list(machines: str) -> List[str]:

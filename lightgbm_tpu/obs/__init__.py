@@ -146,13 +146,17 @@ class TelemetrySession:
         if p99 is not None:
             reg.set_gauge("coll.p99_ms", round(p99, 3))
         try:
-            from ..network import straggler_skew
+            from ..network import straggler_stats
             if self.tracer is not None:
                 dt_s = (self.tracer.now_ns() - self._iter_t0_ns) / 1e9
             else:
                 import time as _time
                 dt_s = _time.perf_counter() - reg._iter_t0
-            reg.set_gauge("coll.host_skew", straggler_skew(dt_s))
+            skew, slowest = straggler_stats(dt_s)
+            reg.set_gauge("coll.host_skew", skew)
+            # lets the hang watchdog NAME the straggling rank at trip
+            # time from already-sampled data (schema minor 8)
+            reg.set_gauge("coll.slowest_rank", slowest)
         except Exception:
             pass
         if self.tracer is not None:

@@ -169,7 +169,8 @@ class MetricsRegistry:
         for key in sorted(self.counters):
             if key.startswith(("collective.", "kernel.", "compile.",
                                "eval.", "hist.", "coll.", "trace.",
-                               "ckpt.", "fault.", "pipeline.")):
+                               "ckpt.", "fault.", "pipeline.",
+                               "watchdog.", "health.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out

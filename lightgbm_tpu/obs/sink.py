@@ -63,8 +63,13 @@ SCHEMA_VERSION = 1
 # joined (pipeline.inflight_fetches / pipeline.delayed_stop_iters /
 # pipeline.donated_bytes under `counters`, the "stop_check" phase
 # timer, and the overlap_share / blocking_syncs_per_iter bench summary
-# fields)
-SCHEMA_MINOR = 7
+# fields), to 8 when the self-healing fields joined (watchdog.trips /
+# watchdog.stall_<class> / watchdog.auto_resume and health.checks /
+# health.sentinel_trips / health.nan / health.overflow /
+# health.quarantined / health.rollbacks / health.degraded /
+# health.quant_tripwire under `counters`, the "coll.slowest_rank"
+# gauge, and the "sentinel" phase timer)
+SCHEMA_MINOR = 8
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")

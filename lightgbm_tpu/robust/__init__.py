@@ -1,22 +1,36 @@
-"""Fault tolerance: checkpoint/resume + deterministic fault injection.
+"""Fault tolerance: checkpoint/resume, fault injection, self-healing.
 
-Two halves (docs/ROBUSTNESS.md):
+Four parts (docs/ROBUSTNESS.md):
 
 - `checkpoint`: periodic atomic training checkpoints (model text + full
   loop state) and resume — a preempted run continues from the last
   checkpoint and, under `deterministic=true`, finishes with a model
   text byte-identical to the uninterrupted run.
 - `faultinject`: named injection seams (checkpoint writes, AOT-store
-  reads, the boosting loop, collective dispatch, telemetry sinks)
-  driven by the `LGBM_TPU_FAULT_PLAN` spec, so every recovery path has
-  a test that actually exercises the failure.
+  reads, the boosting loop, collective dispatch, telemetry sinks, the
+  sentinel) driven by the `LGBM_TPU_FAULT_PLAN` spec, so every recovery
+  path has a test that actually exercises the failure.
+- `watchdog`: deadman timer over the training loop — a hang is
+  detected within `hang_timeout`, classified (collective / dispatch /
+  readback / host-callback), trace-flushed, and either aborted with an
+  actionable error or auto-resumed from the last checkpoint.
+- `sentinel`: device-side numeric-health checks on new trees, with
+  quarantine-and-rollback recovery and a degraded-mode ladder.
 """
 from .checkpoint import CheckpointError, CheckpointManager
 from .faultinject import (FaultPlan, active_plan, check_fault,
                           filter_bytes, install_plan)
+from .sentinel import (DEGRADED_LADDER, NumericSentinel,
+                       apply_degraded_rung)
+from .watchdog import (HangTimeout, Watchdog, activate_watchdog,
+                       active_watchdog, classify_stall,
+                       deactivate_watchdog, watch_phase)
 
 __all__ = [
     "CheckpointError", "CheckpointManager",
     "FaultPlan", "active_plan", "check_fault", "filter_bytes",
     "install_plan",
+    "DEGRADED_LADDER", "NumericSentinel", "apply_degraded_rung",
+    "HangTimeout", "Watchdog", "activate_watchdog", "active_watchdog",
+    "classify_stall", "deactivate_watchdog", "watch_phase",
 ]

@@ -246,8 +246,20 @@ class CheckpointManager:
             pass
 
     def _prune(self) -> None:
+        """Drop everything strictly older than the newest ``keep``
+        checkpoints. Only entries BELOW the kept window are ever
+        unlinked, so a concurrent ``load_latest`` that already picked
+        the newest (or any kept) file from its own listing never has it
+        deleted out from under it; a reader racing on an
+        already-pruned older file sees ``FileNotFoundError`` and
+        retries the next-newer entry without counting it invalid."""
         entries = self._list()
-        for it, name in entries[:-self.keep]:
+        if len(entries) <= self.keep:
+            return
+        keep_floor = entries[-self.keep][0]   # oldest kept iteration
+        for it, name in entries:
+            if it >= keep_floor:
+                break
             try:
                 os.unlink(os.path.join(self.directory, name))
             except OSError:
@@ -278,6 +290,11 @@ class CheckpointManager:
             path = os.path.join(self.directory, name)
             try:
                 state, model_text = self._read(path)
+            except FileNotFoundError:
+                # a concurrent writer's keep-K prune legitimately
+                # removed an older entry between our listing and the
+                # read — not an invalid checkpoint, just keep walking
+                continue
             except (CheckpointError, OSError, ValueError, KeyError) as e:
                 _inc("ckpt.invalid")
                 log.warning("Skipping invalid checkpoint %s: %s", path, e)

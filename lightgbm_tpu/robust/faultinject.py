@@ -18,6 +18,7 @@ seam                      fires in
 ``collective.dispatch``   network.collective_span, around every dispatch
 ``sink.write``            obs/sink.py JSONL metrics writer
 ``trace.export``          obs TelemetrySession.close, before the Perfetto dump
+``sentinel.check``        robust/sentinel.py, at every sentinel dispatch
 ========================  =====================================================
 
 Modes: ``sigkill`` (SIGKILL self — the preemption simulator),
@@ -25,7 +26,13 @@ Modes: ``sigkill`` (SIGKILL self — the preemption simulator),
 ``delay=S`` (sleep S seconds), ``partial`` / ``torn`` (checkpoint-
 writer-interpreted: half-written tmp file, or a truncated file that
 still gets renamed), ``corrupt`` / ``truncate`` (bytes filters for
-blob-reading seams).
+blob-reading seams), ``hang[=S]`` (block the seam for S seconds —
+default 60, always bounded so a drill can never wedge CI — and then
+DISARM: a hang spec fires at most once per process, so an
+``auto_resume`` run that replays the hung iteration does not re-hang),
+``nan`` / ``overflow`` (caller-interpreted numeric poison: the seam
+owner injects NaN / ~1e30 into the plane it guards — the sentinel and
+quarantine drills).
 
 Triggers make plans deterministic: ``@N`` fires on the N-th hit of the
 seam (1-based) — except at index-carrying seams (``train.iteration``),
@@ -51,7 +58,7 @@ from ..utils import log
 ENV_VAR = "LGBM_TPU_FAULT_PLAN"
 
 _MODES = ("sigkill", "enospc", "ioerror", "delay", "partial", "torn",
-          "corrupt", "truncate")
+          "corrupt", "truncate", "hang", "nan", "overflow")
 # modes that are only meaningful on every hit unless pinned explicitly
 _EVERY_HIT_MODES = ("delay", "corrupt", "truncate")
 
@@ -63,7 +70,7 @@ _INDEXED_SEAMS = ("train.iteration",)
 class FaultSpec:
     """One armed seam: seam name, mode, optional arg, trigger."""
 
-    __slots__ = ("seam", "mode", "arg", "trigger", "hits")
+    __slots__ = ("seam", "mode", "arg", "trigger", "hits", "disarmed")
 
     def __init__(self, seam: str, mode: str, arg: float,
                  trigger: Optional[int]) -> None:
@@ -72,8 +79,11 @@ class FaultSpec:
         self.arg = arg
         self.trigger = trigger   # None = every hit
         self.hits = 0
+        self.disarmed = False    # hang specs disarm after firing
 
     def matches(self, index: Optional[int]) -> bool:
+        if self.disarmed:
+            return False
         if self.seam in _INDEXED_SEAMS and index is not None:
             return self.trigger is None or index == self.trigger
         self.hits += 1
@@ -147,6 +157,14 @@ class FaultPlan:
                 os.kill(os.getpid(), signal.SIGKILL)
             elif spec.mode == "delay":
                 time.sleep(spec.arg)
+                return spec
+            elif spec.mode == "hang":
+                # one-shot: an auto_resume run replays the hung
+                # iteration index — without disarming, the replay would
+                # hang again forever. Bounded sleep so a drill without
+                # a watchdog still terminates.
+                spec.disarmed = True
+                time.sleep(spec.arg if spec.arg > 0 else 60.0)
                 return spec
             elif spec.mode == "enospc":
                 raise OSError(errno.ENOSPC,

@@ -1,0 +1,258 @@
+"""Device-side numeric-health sentinels (docs/ROBUSTNESS.md).
+
+Silent numeric corruption — NaN/Inf gradients, overflowed quantized
+histograms, divergent leaf values — trains garbage quietly for hours.
+The sentinel folds tiny finiteness/overflow reductions over arrays the
+boosting loop already owns on device (each new tree's leaf values, and
+on demand the gradient planes) and lets the verdicts ride the existing
+trailing fetches:
+
+- :meth:`NumericSentinel.dispatch` runs one manager-registered jitted
+  reduction per checked array (compiles land in the ``compile.*``
+  counters and the AOT store like every other program; the overflow
+  limit is a runtime scalar operand, so changing it never recompiles)
+  and starts an async copy of the [nonfinite, overflow] verdict;
+- the boosting loop resolves pending verdicts inside the device_get
+  batches it already performs (per-iteration eval fetch, or the
+  periodic trailing stop-check), so a sentinel-enabled steady state
+  adds ZERO blocking syncs per iteration;
+- a trip quarantines the offending tree (boosting/gbdt.py
+  ``quarantine_iter``); repeated trips escalate to checkpoint rollback
+  plus the degraded-mode ladder (:func:`apply_degraded_rung`).
+
+The quantized-gradient path's overflow-escalation counter is promoted
+to a host-side tripwire (:meth:`poll_quant_tripwire`) — reading a
+counter delta is free and catches systematic histogram overflow that
+per-tree checks cannot see.
+
+The ``sentinel.check`` fault seam makes every trip deterministically
+drillable: ``nan`` / ``overflow`` modes poison the checked plane before
+the reduction, so recovery is proven without manufacturing real
+divergence.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .faultinject import check_fault
+
+
+def _health_device(vals, limit):
+    """[nonfinite_count, overflow_count] int32 over one array."""
+    import jax.numpy as jnp
+    v = vals.astype(jnp.float32).ravel()
+    finite = jnp.isfinite(v)
+    nonfinite = jnp.sum(~finite)
+    overflow = jnp.sum(finite & (jnp.abs(v) > limit))
+    return jnp.stack([nonfinite, overflow]).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=1)
+def _health_entry():
+    """Manager-registered entry so sentinel (re)compiles land in the
+    same compile counters / AOT store as the rest of the stack."""
+    import jax
+
+    from ..compile import get_manager
+    return get_manager().jit_entry("robust/sentinel_health",
+                                   jax.jit(_health_device))
+
+
+def _poison(arr, mode: str, limit: float):
+    """First element of ``arr`` replaced by the drill's poison value
+    (NaN or 2x the overflow limit); works for device and host arrays."""
+    bad = float("nan") if mode == "nan" else 2.0 * limit
+    if isinstance(arr, np.ndarray):
+        out = arr.astype(np.float64, copy=True).ravel()
+        out[0] = bad
+        return out.reshape(arr.shape)
+    import jax.numpy as jnp
+    flat = jnp.ravel(arr).astype(jnp.float32)
+    return flat.at[0].set(jnp.float32(bad)).reshape(arr.shape)
+
+
+class NumericSentinel:
+    """Host-side manager for the per-tree health checks.
+
+    ``dispatch`` is called by the boosting loop right after a new
+    tree's arrays exist; ``take_pending`` / ``resolve`` integrate the
+    verdict readback into the loop's existing batched fetches;
+    ``pop_trips`` hands confirmed trips to the recovery policy.
+    """
+
+    def __init__(self, overflow_limit: float = 1e30, max_trips: int = 2,
+                 quant_escalation_limit: int = 32) -> None:
+        self.overflow_limit = float(overflow_limit)
+        self.max_trips = int(max_trips)
+        self.quant_escalation_limit = int(quant_escalation_limit)
+        self.trips = 0        # confirmed trips since the last rollback
+        self.total_trips = 0  # confirmed trips over the sentinel's life
+        self.checks = 0
+        self._pending: List[Tuple[int, Any]] = []   # (iteration, verdict ref)
+        self._trips_out: List[Tuple[int, str]] = []  # resolved, unprocessed
+        self._quant_base: Optional[float] = None
+        self._quant_warned = False
+
+    # -- dispatch -------------------------------------------------------
+    def dispatch(self, arrays: List[Any], iteration: int) -> None:
+        """Queue health checks over ``arrays`` (device or host) for
+        boosting iteration ``iteration``. Device verdicts resolve later
+        through :meth:`resolve`; host arrays are judged immediately."""
+        spec = check_fault("sentinel.check")
+        mode = spec.mode if spec is not None \
+            and spec.mode in ("nan", "overflow") else None
+        self.checks += 1
+        self._count("health.checks")
+        for i, arr in enumerate(arrays):
+            if mode is not None and i == 0:
+                arr = _poison(arr, mode, self.overflow_limit)
+            if isinstance(arr, np.ndarray):
+                self._judge(iteration, self._host_verdict(arr))
+                continue
+            verdict = _health_entry()(
+                arr, np.float32(self.overflow_limit))
+            try:
+                verdict.copy_to_host_async()
+            except Exception:
+                pass
+            self._pending.append((iteration, verdict))
+
+    def _host_verdict(self, arr: np.ndarray) -> np.ndarray:
+        finite = np.isfinite(arr)
+        return np.asarray([int((~finite).sum()),
+                           int((finite & (np.abs(arr)
+                                          > self.overflow_limit)).sum())])
+
+    # -- resolution (piggybacked on existing batched fetches) -----------
+    def take_pending(self) -> List[Tuple[int, Any]]:
+        """Hand the un-resolved verdict refs to the caller's batched
+        device_get; the caller passes the fetched values to
+        :meth:`resolve` with the same list."""
+        pending, self._pending = self._pending, []
+        return pending
+
+    def resolve(self, pending: List[Tuple[int, Any]],
+                host_values: List[Any]) -> None:
+        for (iteration, _), value in zip(pending, host_values):
+            self._judge(iteration, np.asarray(value))
+
+    def _judge(self, iteration: int, verdict: np.ndarray) -> None:
+        nonfinite, overflow = int(verdict[0]), int(verdict[1])
+        if nonfinite == 0 and overflow == 0:
+            return
+        kind = "nan" if nonfinite > 0 else "overflow"
+        self.trips += 1
+        self.total_trips += 1
+        self._trips_out.append((iteration, kind))
+        self._count("health.sentinel_trips")
+        self._count(f"health.{kind}")
+        log.warning(
+            "sentinel: numeric-health trip at iteration %d — %d non-finite"
+            " / %d overflowed (>|%g|) values in the new tree",
+            iteration, nonfinite, overflow, self.overflow_limit)
+
+    def pop_trips(self) -> List[Tuple[int, str]]:
+        """Resolved-but-unprocessed trips, oldest first (the recovery
+        policy quarantines / rolls back from these)."""
+        out, self._trips_out = self._trips_out, []
+        return out
+
+    @property
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def drop_pending(self) -> None:
+        """Abandon un-resolved verdicts and un-processed trips — a
+        rollback restored state from BEFORE the checked iterations ever
+        happened, so their verdicts describe a discarded timeline."""
+        self._pending = []
+        self._trips_out = []
+
+    def reset_trips(self) -> None:
+        """Re-arm the escalation threshold after a rollback: trips are
+        counted per recovery epoch, ``total_trips`` keeps the life
+        total."""
+        self.trips = 0
+
+    # -- quantized-path tripwire ----------------------------------------
+    def poll_quant_tripwire(self) -> bool:
+        """Promote the quantized-histogram overflow-escalation counter
+        to a tripwire: sustained escalation past the limit means the
+        quantized bins systematically overflow (bad data or too few
+        bins), which per-tree leaf checks cannot see."""
+        try:
+            from ..obs import active as obs_active
+            reg = obs_active()
+            if reg is None:
+                return False
+            cur = reg.counters.get("hist.quant_overflow_escalations", 0)
+        except Exception:
+            return False
+        if self._quant_base is None:
+            self._quant_base = cur
+            return False
+        if cur - self._quant_base <= self.quant_escalation_limit \
+                or self._quant_warned:
+            return False
+        self._quant_warned = True
+        self._count("health.quant_tripwire")
+        log.warning(
+            "sentinel: quantized-histogram overflow escalated %d times "
+            "since training started (limit %d) — consider more "
+            "num_grad_quant_bins or disabling gradient quantization",
+            int(cur - self._quant_base), self.quant_escalation_limit)
+        return True
+
+    @staticmethod
+    def _count(name: str) -> None:
+        try:
+            from ..obs import active as obs_active
+            reg = obs_active()
+            if reg is not None:
+                reg.inc(name)
+        except Exception:
+            pass
+
+
+# -- degraded-mode ladder -------------------------------------------------
+# rung order: cheapest capability lost first
+DEGRADED_LADDER = ("pipeline", "device_eval", "aot_store")
+
+
+def apply_degraded_rung(gbdt, rung_index: int) -> Optional[str]:
+    """Apply ladder rung ``rung_index`` (0-based) to a live booster:
+    0 = pipelined loop -> synchronous loop, 1 = device-side eval ->
+    host eval, 2 = AOT executable store -> plain jit. Returns the rung
+    name, or None when the ladder is exhausted."""
+    if rung_index >= len(DEGRADED_LADDER):
+        return None
+    rung = DEGRADED_LADDER[rung_index]
+    if rung == "pipeline":
+        gbdt._pipeline = False
+    elif rung == "device_eval":
+        gbdt._device_eval = False
+    elif rung == "aot_store":
+        import os
+
+        os.environ["LGBM_TPU_AOT"] = "0"
+        try:
+            from ..compile import get_manager
+            mgr = get_manager()
+            if getattr(mgr, "aot_enabled", None) is not None:
+                mgr.aot_enabled = False
+        except Exception:
+            pass
+    try:
+        from ..obs import active as obs_active
+        reg = obs_active()
+        if reg is not None:
+            reg.inc("health.degraded")
+    except Exception:
+        pass
+    log.warning("degraded mode: stepping down rung %d (%s) after repeated "
+                "numeric-health trips", rung_index, rung)
+    return rung

@@ -10,11 +10,24 @@ the checkpoint directory with no plan armed; run 3 is the
 uninterrupted baseline. The drill passes iff run 2's and run 3's saved
 model text hash identically.
 
+Two further drills exercise the PR 9 self-healing paths:
+
+- hang drill (`CHAOS_DRILL=hang`): a `train.iteration:hang` fault
+  blocks the loop mid-run; the watchdog must detect it within
+  `hang_timeout`, classify the stall, and auto-resume from the last
+  checkpoint — the finished model must hash identically to the
+  uninterrupted baseline.
+- NaN drill (`CHAOS_DRILL=nan`): a `train.iteration:nan` fault poisons
+  one gradient plane; the numeric sentinels must trip, quarantine
+  exactly that iteration's tree, and let the run finish with ITERS-1
+  healthy trees.
+
 Run on the chip (or anywhere):  python scripts/chaos_train.py
 Env: CHAOS_ROWS (default 1_000_000), CHAOS_COLS (default 28 — the
 HIGGS width), CHAOS_ITERS (default 60), CHAOS_KILL_AT (default
-ITERS // 2 + 1), CHAOS_INTERVAL (checkpoint interval, default 10),
-CHAOS_FUSED (1/0, default 1).
+ITERS // 2 + 1, also the hang/NaN injection point), CHAOS_INTERVAL
+(checkpoint interval, default 10), CHAOS_FUSED (1/0, default 1),
+CHAOS_DRILL (kill | hang | nan | all, default kill).
 """
 import hashlib
 import os
@@ -34,6 +47,7 @@ ITERS = int(os.environ.get("CHAOS_ITERS", 60))
 KILL_AT = int(os.environ.get("CHAOS_KILL_AT", ITERS // 2 + 1))
 INTERVAL = int(os.environ.get("CHAOS_INTERVAL", 10))
 FUSED = os.environ.get("CHAOS_FUSED", "1") != "0"
+DRILL = os.environ.get("CHAOS_DRILL", "kill")
 
 
 def make_higgs_like(n, f, seed=17):
@@ -58,6 +72,12 @@ def child_train(ckpt_dir: str, out_path: str) -> None:
               "num_leaves": 63, "learning_rate": 0.1,
               "tpu_fused": FUSED,
               "checkpoint_interval": INTERVAL}
+    hang_timeout = float(os.environ.get("CHAOS_HANG_TIMEOUT", "0"))
+    if hang_timeout > 0:
+        params["hang_timeout"] = hang_timeout
+        params["auto_resume"] = True
+    if os.environ.get("CHAOS_SENTINELS") == "1":
+        params["numeric_sentinels"] = True
     t0 = time.time()
     bst = lgb.train(params, lgb.Dataset(X, label=y),
                     num_boost_round=ITERS, verbose_eval=False,
@@ -89,11 +109,13 @@ def sha(path: str) -> str:
         return hashlib.sha256(fh.read()).hexdigest()
 
 
-def main() -> int:
-    if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child_train(sys.argv[2], sys.argv[3])
-        return 0
+def tree_count(path: str) -> int:
+    with open(path) as fh:
+        return sum(1 for line in fh if line.startswith("Tree="))
 
+
+def drill_kill() -> int:
+    """SIGKILL mid-train, resume from checkpoints, hash vs baseline."""
     work = tempfile.mkdtemp(prefix="lgbm_tpu_chaos_")
     ckpt_dir = os.path.join(work, "ckpt")
     out_resumed = os.path.join(work, "model_resumed.txt")
@@ -136,6 +158,85 @@ def main() -> int:
     print("PASS: killed + resumed training is byte-identical to the "
           "uninterrupted run")
     return 0
+
+
+def drill_hang() -> int:
+    """Hang mid-train: the watchdog must fire, auto-resume from the
+    last checkpoint IN-PROCESS, and still finish with a model
+    byte-identical to an uninterrupted run."""
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_hang_")
+    ckpt_dir = os.path.join(work, "ckpt")
+    out_hung = os.path.join(work, "model_hung.txt")
+    out_fresh = os.path.join(work, "model_fresh.txt")
+    # the injected hang outlives the watchdog timeout by a wide margin
+    # so detection — not luck — ends the stall
+    timeout = float(os.environ.get("CHAOS_HANG_TIMEOUT", "0") or "1.0")
+    os.environ["CHAOS_HANG_TIMEOUT"] = str(timeout)
+    hang_s = max(4 * timeout, 2.0)
+    print(f"[parent] hang drill: {hang_s:.1f}s stall entering iteration "
+          f"{KILL_AT}, watchdog timeout {timeout:.1f}s, checkpoint "
+          f"every {INTERVAL}", flush=True)
+
+    if run_child(ckpt_dir, out_hung,
+                 fault_plan=f"train.iteration:hang={hang_s}@{KILL_AT}") != 0:
+        print("FAIL: hung child did not auto-resume to completion")
+        return 1
+    if run_child("", out_fresh) != 0:
+        print("FAIL: baseline run did not complete")
+        return 1
+
+    h_hung, h_fresh = sha(out_hung), sha(out_fresh)
+    print(f"[parent] auto-resumed {h_hung}")
+    print(f"[parent] baseline     {h_fresh}")
+    if h_hung != h_fresh:
+        print("FAIL: auto-resumed model text differs from the "
+              "uninterrupted baseline")
+        return 1
+    print("PASS: hang was detected and auto-resumed; the model is "
+          "byte-identical to the uninterrupted run")
+    return 0
+
+
+def drill_nan() -> int:
+    """Poison one iteration's gradient plane with NaN: the numeric
+    sentinels must trip, quarantine exactly that tree, and let the run
+    finish with ITERS-1 healthy trees."""
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_nan_")
+    out_path = os.path.join(work, "model_nan.txt")
+    os.environ["CHAOS_SENTINELS"] = "1"
+    # the fused path keeps gradients device-resident, so the poison
+    # lands at the sentinel.check seam (leaf-value plane); the host
+    # loop takes the NaN straight into its gradient plane
+    plan = (f"sentinel.check:nan@{KILL_AT}" if FUSED
+            else f"train.iteration:nan@{KILL_AT}")
+    print(f"[parent] NaN drill: plane poisoned at iteration ~{KILL_AT} "
+          f"({plan}), sentinels armed", flush=True)
+
+    if run_child("", out_path, fault_plan=plan) != 0:
+        print("FAIL: poisoned run did not complete")
+        return 1
+    trees = tree_count(out_path)
+    if trees != ITERS - 1:
+        print(f"FAIL: expected {ITERS - 1} trees after quarantining the "
+              f"poisoned iteration, got {trees}")
+        return 1
+    print(f"PASS: poisoned iteration quarantined; {trees}/{ITERS} "
+          "healthy trees survive")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_train(sys.argv[2], sys.argv[3])
+        return 0
+
+    drills = {"kill": drill_kill, "hang": drill_hang, "nan": drill_nan}
+    if DRILL == "all":
+        return max(d() for d in drills.values())
+    if DRILL not in drills:
+        print(f"unknown CHAOS_DRILL={DRILL!r} (kill | hang | nan | all)")
+        return 2
+    return drills[DRILL]()
 
 
 if __name__ == "__main__":

@@ -21,7 +21,9 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   `coll_p99_ms` bench summary fields; v1.6 adds the fault-tolerance
   `ckpt.*`/`fault.*` counters; v1.7 adds the async-pipeline
   `pipeline.*` counters, the `stop_check` phase timer, and the
-  `overlap_share` / `blocking_syncs_per_iter` bench summary fields),
+  `overlap_share` / `blocking_syncs_per_iter` bench summary fields;
+  v1.8 adds the self-healing `watchdog.*` / `health.*` counters, the
+  `coll.slowest_rank` gauge, and the `sentinel` phase timer),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py
