@@ -157,6 +157,13 @@ def emit(partial: bool) -> None:
         out["aot_compile_s"] = round(stats.get("compile_s", 0.0), 2)
         out["warm_start"] = int(loads > 0 and stats.get("cache_misses", 0)
                                 == 0)
+        # compiled-program accounting (schema minor 9): distinct traced
+        # programs this process compiled (AOT + plain-jit cache growth),
+        # trace+lower seconds, and lowered-module bytes — the compile-
+        # window regression gate compares these against BENCH_r*.json
+        out["compile_programs"] = int(stats.get("programs", 0))
+        out["compile_lowering_s"] = round(stats.get("lowering_s", 0.0), 2)
+        out["compile_hlo_bytes"] = int(stats.get("hlo_bytes", 0))
     except Exception:
         pass
     # provenance + latency shape (schema minor 2) — appended after the

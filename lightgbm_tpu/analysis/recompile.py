@@ -1,6 +1,6 @@
 """Rule pack: recompile-hazard.
 
-Three sub-rules protecting the AOT compile cache (PR 2):
+Four sub-rules protecting the AOT compile cache (PR 2, extended PR 10):
 
 1. **jit-unmanaged** — every `jax.jit` site outside `compile/` must
    route through the compile manager (`get_manager().jit_entry(...)` /
@@ -18,6 +18,13 @@ Three sub-rules protecting the AOT compile cache (PR 2):
    two configs differing only in that field replay the SAME serialized
    executable. Also flags stale `_IGNORED_CONFIG_FIELDS` entries that no
    longer name a Config dataclass field.
+4. **switch-ladder** — a `lax.switch` whose branch list comes from a
+   list comprehension (the capacity-ladder shape: one branch body per
+   size bucket). Every branch is cloned into the enclosing HLO, so a
+   ladder over kernel bodies multiplies program size by its length —
+   the exact bloat PR 10's dynamic-grid kernels removed. Escape with
+   `# tpulint: switch-ok(<reason>)` where static branch widths are
+   genuinely required (e.g. XLA-sliced fallback paths).
 """
 from __future__ import annotations
 
@@ -299,4 +306,37 @@ def check(pkg: Package) -> List[Finding]:
                     f"Config.{node.attr} is read inside traced code but "
                     "listed in _IGNORED_CONFIG_FIELDS — two configs "
                     "differing only here would share one executable"))
+
+    # (4) lax.switch branch ladders built by list comprehension
+    for rel in sorted(pkg.files):
+        sf = pkg.files[rel]
+        comp_names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.ListComp):
+                comp_names |= {t.id for t in node.targets
+                               if isinstance(t, ast.Name)}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            fd = dotted(node.func)
+            if fd is None:
+                continue
+            parts = fd.split(".")
+            if parts[-1] != "switch" or "lax" not in parts[:-1]:
+                continue
+            br = node.args[1]
+            if not (isinstance(br, ast.ListComp)
+                    or (isinstance(br, ast.Name) and br.id in comp_names)):
+                continue
+            if sf.pragma_at(node.lineno, "switch-ok"):
+                continue
+            fi = pkg.enclosing_function(rel, node)
+            findings.append(Finding(
+                "recompile-hazard", rel, node.lineno,
+                fi.qual if fi is not None else "", "switch-ladder",
+                "lax.switch over a comprehension-built branch ladder "
+                "clones every branch body into the enclosing HLO; "
+                "parameterize the kernel by runtime size (dynamic grid) "
+                "or annotate `# tpulint: switch-ok(<reason>)`"))
     return findings

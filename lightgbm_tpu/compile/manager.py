@@ -36,7 +36,8 @@ import jax
 
 from ..utils import log
 from . import signature as S
-from .store import CorruptBlobError, ExecutableStore, store_enabled
+from .store import (CorruptBlobError, ExecutableStore, min_compile_s,
+                    store_enabled)
 
 _FALLBACK = object()  # dispatch marker: this key uses plain jit forever
 
@@ -86,11 +87,17 @@ class SharedEntry:
 
     def __init__(self, manager: "CompileManager", name: str,
                  digest: str, build: Callable[[], Callable],
-                 donate_argnums: Tuple[int, ...] = ()) -> None:
+                 donate_argnums: Tuple[int, ...] = (),
+                 store: bool = True) -> None:
         self.manager = manager
         self.name = name
         self.digest = digest
         self.donate_argnums = tuple(donate_argnums)
+        # store=False: compile + share in-memory, but never persist —
+        # used when the signature fell back to a per-instance uid
+        # (io/dataset.py trace_signature), which would pollute the
+        # on-disk store with keys no later process can ever hit
+        self.store = bool(store)
         self._build = build
         self._jfn: Optional[Callable] = None
         # guards _jfn / _key_cache / specs: entries are shared across
@@ -183,6 +190,8 @@ class JitEntry:
                 # first call traces+compiles+runs; attributing the whole
                 # call to compile slightly overcounts by one execution
                 self.manager.count("jit_compiles")
+                # each cache growth is one more distinct traced program
+                self.manager.count("programs", after - before)
                 self.manager.add_time("compile", time.perf_counter() - t0)
         return out
 
@@ -227,7 +236,8 @@ class CompileManager:
     # -- registration ---------------------------------------------------
     def shared_entry(self, name: str, sig: Any,
                      build: Callable[[], Callable],
-                     donate_argnums: Tuple[int, ...] = ()) -> SharedEntry:
+                     donate_argnums: Tuple[int, ...] = (),
+                     store: bool = True) -> SharedEntry:
         """The entry for (name, signature), creating it on first use.
         A pre-existing entry keeps ITS builder: signatures are defined
         precisely so equal digests trace identical programs.
@@ -242,7 +252,8 @@ class CompileManager:
             if entry is not None:
                 self.shared.move_to_end(digest)
                 return entry
-            entry = SharedEntry(self, name, digest, build, donate_argnums)
+            entry = SharedEntry(self, name, digest, build, donate_argnums,
+                                store=store)
             self.shared[digest] = entry
             while len(self.shared) > _MAX_SHARED_ENTRIES:
                 self.shared.popitem(last=False)
@@ -283,6 +294,8 @@ class CompileManager:
             return exe
 
     def _load_from_store(self, entry: SharedEntry, key: str) -> Any:
+        if not entry.store:
+            return None
         try:
             t0 = time.perf_counter()
             triple = self.store.load(key)
@@ -310,14 +323,32 @@ class CompileManager:
             t0 = time.perf_counter()
             with self._trace_lock:
                 lowered = entry.jit_fn().lower(*args, **statics)
+            t1 = time.perf_counter()
             exe = lowered.compile()
-            self.add_time("compile", time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            self.add_time("compile", elapsed)
+            # distinct-program accounting (obs schema v1.9): every real
+            # compile is one program; `lowering_s` isolates the
+            # trace+lower span (where the old per-width kernel unroll
+            # burned its 70 minutes) from XLA compile proper
+            self.count("programs")
+            self.count("lowering_s", t1 - t0)
             self.count("cache_misses")
-            t0 = time.perf_counter()
-            triple = serialize(exe)
-            if self.store.save(key, triple):
-                self.add_time("aot_serialize", time.perf_counter() - t0)
-                self.count("store_saves")
+            # persist (and pay the HLO-text stat) only for compiles
+            # slower than the threshold: sub-threshold programs cost
+            # more in serialize + blob + manifest traffic than their
+            # recompile, and `hlo_bytes` sizes what the store holds —
+            # the programs the compile window is actually made of
+            if entry.store and elapsed >= min_compile_s():
+                try:
+                    self.count("hlo_bytes", len(lowered.as_text()))
+                except Exception:
+                    pass
+                t0 = time.perf_counter()
+                triple = serialize(exe)
+                if self.store.save(key, triple):
+                    self.add_time("aot_serialize", time.perf_counter() - t0)
+                    self.count("store_saves")
             return exe
         except Exception as exc:
             log.debug("AOT compile failed for %s (%s); using plain jit",

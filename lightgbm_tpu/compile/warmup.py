@@ -62,6 +62,7 @@ def _join_background_threads() -> None:
 def _pending_specs(mgr: CompileManager
                    ) -> List[Tuple[SharedEntry, str, Any, Dict[str, Any]]]:
     out = []
+    seen = set()
     for entry in list(mgr.shared.values()):
         # snapshot under the entry lock: learners may still be
         # registering specs while a warmup thread walks the list
@@ -69,6 +70,13 @@ def _pending_specs(mgr: CompileManager
             specs = list(entry.specs)
         for args, statics in specs:
             key = entry.key_for(args, statics)
+            # dedupe across entries too: signature bucketing can
+            # collide specs from different learners (serial/fused/MC
+            # variants) onto one key — compile each shared signature
+            # exactly once
+            if key in seen:
+                continue
+            seen.add(key)
             if mgr.executables.get(key) is None:
                 out.append((entry, key, args, statics))
     return out

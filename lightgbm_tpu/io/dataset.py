@@ -490,6 +490,46 @@ class BinnedDataset:
             return 0
         return self._monotone_constraints[inner_feature]
 
+    def trace_signature(self) -> "tuple[str, bool]":
+        """(digest, shareable) identity of everything dataset-derived
+        that shapes a traced learner program.
+
+        Bin boundary VALUES deliberately do not enter: traced programs
+        operate on bin codes and route on bin-index thresholds, so two
+        datasets with identical mapper *structure* (per-feature num_bin
+        / missing_type / default_bin / bin_type), identical monotone
+        constraints, and identical EFB bundle tables trace byte-
+        identical programs — letting same-shaped learners share one
+        compiled executable (compile/manager.py shared_entry).
+
+        EFB table CONTENTS are hashed (not just shape) because learners
+        close over the device copies; two different bundlings must not
+        alias one program.
+
+        On any failure the fallback is a per-instance uid: sharing is
+        lost, correctness kept — callers should then register their
+        entries with store=False so uid keys never pollute the on-disk
+        AOT store."""
+        if getattr(self, "_trace_sig", None) is None:
+            import hashlib
+            try:
+                h = hashlib.sha256()
+                for m in self.bin_mappers:
+                    h.update(("%d,%d,%d,%d;" % (
+                        m.num_bin, m.missing_type, m.default_bin,
+                        m.bin_type)).encode())
+                h.update(np.asarray(self._monotone_constraints or [],
+                                    np.int32).tobytes())
+                bt = self.bundles
+                if bt is not None and not bt.is_trivial:
+                    for a in (bt.group_of, bt.offset_of, bt.nslots_of,
+                              bt.skip_of, bt.group_num_bins):
+                        h.update(np.ascontiguousarray(a).tobytes())
+                self._trace_sig = ("ds-" + h.hexdigest()[:20], True)
+            except Exception:
+                self._trace_sig = ("uid-%x" % id(self), False)
+        return self._trace_sig
+
     # --- binary cache (reference Dataset::SaveBinaryFile, dataset.cpp:890) ---
     def save_binary(self, filename: str) -> None:
         header = {

@@ -68,8 +68,15 @@ SCHEMA_VERSION = 1
 # health.sentinel_trips / health.nan / health.overflow /
 # health.quarantined / health.rollbacks / health.degraded /
 # health.quant_tripwire under `counters`, the "coll.slowest_rank"
-# gauge, and the "sentinel" phase timer)
-SCHEMA_MINOR = 8
+# gauge, and the "sentinel" phase timer), to 9 when the compiled-
+# program accounting joined (compile.programs distinct-program
+# counter, compile.lowering_s cumulative trace+lower seconds, and
+# compile.hlo_bytes lowered-module size of the persisted programs
+# (sub-LGBM_TPU_AOT_MIN_COMPILE_S compiles skip the stat) under
+# `counters`, plus the
+# compile_programs / compile_lowering_s / compile_hlo_bytes bench
+# summary fields)
+SCHEMA_MINOR = 9
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -89,7 +96,10 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        # runtime trace timeline (schema minor 5)
                        "mem_peak_bytes", "coll_p99_ms",
                        # async pipelined iteration (schema minor 7)
-                       "overlap_share", "blocking_syncs_per_iter")
+                       "overlap_share", "blocking_syncs_per_iter",
+                       # compiled-program accounting (schema minor 9)
+                       "compile_programs", "compile_lowering_s",
+                       "compile_hlo_bytes")
 # optional string-typed bench keys (minor 2): histogram kernel variant;
 # (minor 5): runtime trace output path
 _BENCH_OPTIONAL_STR = ("hist_method", "trace_file")

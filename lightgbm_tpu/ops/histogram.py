@@ -294,6 +294,37 @@ def _chunk_onehot_consts(Fc, Bh, Bl, dtype):
     return ex_lo, slot_lo, ex_hi, slot_hi
 
 
+def _chunk_partials(lo_c, hi_c, g_t, h_t, *, Fc, Bh, Bl, dtype,
+                    int_out=False):
+    """One feature chunk's histogram partial: (pg, ph) each
+    [Fc*Bh, Fc*Bl], from the chunk's low/high code rows [Fc, Rb] (already
+    in ``dtype``) and the masked grad/hess lane rows [1, Rb].
+
+    Shared verbatim by the unrolled body (`_accum_chunks`) and the
+    grid-parameterized body (`_radix_planar_kernel_grid`) so the two
+    paths stay bit-identical: same operands, same matmul shapes, same
+    f32 accumulators."""
+    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    ex_lo, slot_lo, ex_hi, slot_hi = _chunk_onehot_consts(Fc, Bh, Bl, dtype)
+    mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
+             == slot_lo).astype(dtype)            # [Fc*Bl, Rb]
+    mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
+             == slot_hi)                          # [Fc*Bh, Rb] bool
+    ag = mhi_t.astype(dtype) * g_t
+    ah = mhi_t.astype(dtype) * h_t
+    pg = jax.lax.dot_general(
+        ag, mlo_t, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)
+    ph = jax.lax.dot_general(
+        ah, mlo_t, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)
+    if int_out:
+        pg = pg.astype(jnp.int32)
+        ph = ph.astype(jnp.int32)
+    return pg, ph
+
+
 def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype,
                   int_out=False):
     """Accumulate CC feature chunks of ``ct`` [CC*Fc, Rb] into
@@ -302,30 +333,14 @@ def _accum_chunks(ct, g_t, h_t, out_ref, *, CC, Fc, Bh, Bl, bl_bits, dtype,
     ``int_out``: out_ref is int32 and g_t/h_t hold quantized levels —
     the per-block matmul partial (exact in its f32 accumulator, bounded
     by Rb * qmax < 2^24) is snapped to int32 before accumulating."""
-    prec = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
-            else jax.lax.Precision.DEFAULT)
     lo_t = (ct & (Bl - 1)).astype(dtype)
     hi_t = (ct >> bl_bits).astype(dtype)
-    fcl, fch = Fc * Bl, Fc * Bh
-    ex_lo, slot_lo, ex_hi, slot_hi = _chunk_onehot_consts(Fc, Bh, Bl, dtype)
+    fch = Fc * Bh
     for c in range(CC):
         lo_c = lo_t[c * Fc:(c + 1) * Fc, :]       # [Fc, Rb]
         hi_c = hi_t[c * Fc:(c + 1) * Fc, :]
-        mlo_t = (jnp.dot(ex_lo, lo_c, preferred_element_type=jnp.float32)
-                 == slot_lo).astype(dtype)        # [fcl, Rb]
-        mhi_t = (jnp.dot(ex_hi, hi_c, preferred_element_type=jnp.float32)
-                 == slot_hi)                      # [fch, Rb] bool
-        ag = mhi_t.astype(dtype) * g_t
-        ah = mhi_t.astype(dtype) * h_t
-        pg = jax.lax.dot_general(
-            ag, mlo_t, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)
-        ph = jax.lax.dot_general(
-            ah, mlo_t, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)
-        if int_out:
-            pg = pg.astype(jnp.int32)
-            ph = ph.astype(jnp.int32)
+        pg, ph = _chunk_partials(lo_c, hi_c, g_t, h_t, Fc=Fc, Bh=Bh, Bl=Bl,
+                                 dtype=dtype, int_out=int_out)
         out_ref[0, c, 0:fch, :] += pg
         out_ref[0, c, fch:2 * fch, :] += ph
 
@@ -494,24 +509,109 @@ def _radix_planar_kernel(scal, codes_ref, gh_ref, out_ref, *, CC, Fc, Bh,
                       bl_bits=bl_bits, dtype=dtype, int_out=quant)
 
 
+def _radix_planar_kernel_grid(scal, codes_ref, gh_ref, out_ref, *, CC, Fc,
+                              Bh, Bl, bl_bits, dtype, code_bits, gh_off,
+                              Rb, SP, quant=False):
+    """Grid-parameterized planar body: ONE feature chunk per grid step.
+
+    Grid is (C, nblk) with C = CS*CC flat chunks — the chunk loop that
+    `_radix_planar_kernel` unrolls CC× into its body rides the grid
+    instead, so the lowered program holds exactly one chunk's matmuls no
+    matter how wide the dataset is (the round-4 70-minute Mosaic
+    lowering cliff is structurally impossible: program size is constant
+    in the column count, which only appears in the grid bounds).
+
+    The codes block is the chunk's parent SP-plane block (index c//CC),
+    so within a super-chunk the same block is fetched once per chunk per
+    row block — CC× the DMA of the unrolled body, but the kernel is
+    one-hot-VPU-bound (~16 us compute vs ~80 ns DMA per step at
+    Rb=1024) and the pipeline overlaps the refetch. The chunk's Fc code
+    rows are selected from the unpacked [CC*Fc, Rb] block by a masked
+    sum over the CC static sub-slices (int32-exact; Mosaic has no
+    dynamic sublane slice), keyed on the traced chunk id — so the
+    accumulated values, and their per-element accumulation order across
+    row blocks, match the unrolled body bit for bit."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    # which of the super-chunk's CC chunks this step owns
+    cc = jax.lax.rem(pl.program_id(0), CC)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(i <= scal[3])
+    def _active():
+        x = codes_ref[...]                         # [SP, Rb] i32
+        off, count = scal[1], scal[2]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, Rb), 1) + i * Rb
+        valid = ((pos >= off) & (pos < off + count)).astype(jnp.float32)
+
+        if quant:
+            w = gh_ref[gh_off:gh_off + 1, :]       # [1, Rb] i32
+            g_t = ((w >> 16).astype(jnp.float32) * valid).astype(dtype)
+            h_t = ((w & 0xFFFF).astype(jnp.float32) * valid).astype(dtype)
+        else:
+            gh = jax.lax.bitcast_convert_type(
+                gh_ref[gh_off:gh_off + 2, :], jnp.float32)
+            g_t = (gh[0:1, :] * valid).astype(dtype)
+            h_t = (gh[1:2, :] * valid).astype(dtype)
+
+        k = 32 // code_bits
+        mask = (1 << code_bits) - 1
+        Fsp = SP * k                               # = CC * Fc
+        e = jnp.broadcast_to(x[:, None, :], (SP, k, Rb)).reshape(Fsp, Rb)
+        sh = (jax.lax.broadcasted_iota(jnp.int32, (Fsp, 1), 0) % k) \
+            * code_bits
+        ct = jax.lax.shift_right_logical(e, sh) & mask     # [Fsp, Rb]
+        if CC == 1:
+            ck = ct
+        else:
+            ck = jnp.zeros((Fc, Rb), jnp.int32)
+            for j in range(CC):
+                ck = ck + jnp.where(cc == j, ct[j * Fc:(j + 1) * Fc, :], 0)
+        lo_c = (ck & (Bl - 1)).astype(dtype)
+        hi_c = (ck >> bl_bits).astype(dtype)
+        pg, ph = _chunk_partials(lo_c, hi_c, g_t, h_t, Fc=Fc, Bh=Bh, Bl=Bl,
+                                 dtype=dtype, int_out=quant)
+        fch = Fc * Bh
+        out_ref[0, 0:fch, :] += pg
+        out_ref[0, fch:2 * fch, :] += ph
+
+
 # tpulint: jit-ok(kernel entry; dispatched through manager-registered learner entries)
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_cols",
                                              "code_bits", "grad_plane",
                                              "cap", "dtype",
                                              "rows_per_block", "interpret",
-                                             "quant"))
+                                             "quant", "unroll"))
 def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
                             num_cols: int, code_bits: int, grad_plane: int,
-                            cap: int, dtype=jnp.float32,
+                            cap: Optional[int] = None, dtype=jnp.float32,
                             rows_per_block: Optional[int] = None,
                             interpret: bool = False,
-                            quant: bool = False) -> jax.Array:
+                            quant: bool = False,
+                            unroll: bool = False) -> jax.Array:
     """Leaf-window histogram straight off the planar state.
 
     data: [P, R] int32 planar training rows; the window is the lane
-    range [start, start+count), read as `cap//Rb + 1` aligned blocks per
-    super-chunk of 8 code planes (grid=(CS, nblk) — feature chunks ride
-    the grid so the program no longer scales with the column count).
+    range [start, start+count).
+
+    ``cap=None`` (the default) is the grid-parameterized mode: the row
+    blocks ride a DYNAMIC grid dimension sized `last_block + 1` from the
+    traced window scalars, so ONE lowered program serves every leaf size
+    — the capacity ladder that used to pick a static `cap` per leaf
+    bucket collapses to this single call. ``cap=<int>`` keeps the static
+    `cap//Rb + 1` block sweep (every block past the window skipped via
+    the prefetched scalars) for callers that need a shape-stable grid.
+
+    ``unroll=True`` selects the legacy body that unrolls all CC chunks
+    of a super-chunk per grid step (grid=(CS, nblk)); the default body
+    puts feature chunks on the grid too (grid=(CS*CC, nblk)), so program
+    size is constant in the column count. Both bodies are bit-identical
+    per output element.
+
     Returns [num_cols, num_bins, 2] f32 — or int32 when ``quant``, in
     which case the grad plane holds packed ``(qg << 16) | qh`` level
     words (ops/quantize.py) and accumulation is exact integer.
@@ -532,48 +632,77 @@ def histogram_planar_pallas(data: jax.Array, start, count, *, num_bins: int,
     # (plane.make_layout guarantees grad % 8 <= 6)
     gh_blk, gh_off = grad_plane // 8, grad_plane % 8
     assert gh_off <= 6, grad_plane
-    assert cap % Rb == 0, (cap, Rb)  # window coverage needs Rb | cap
-    nblk = cap // Rb + 1
-    assert nblk * Rb <= R
+    assert Rb <= R, (Rb, R)
 
     start = jnp.asarray(start, jnp.int32)
-    rs_blk = jnp.clip(start // Rb, 0, R // Rb - nblk)
-    off = start - rs_blk * Rb
     count = jnp.asarray(count, jnp.int32)
+    if cap is not None:
+        assert cap % Rb == 0, (cap, Rb)  # window coverage needs Rb | cap
+        nblk = cap // Rb + 1
+        assert nblk * Rb <= R
+        rs_blk = jnp.clip(start // Rb, 0, R // Rb - nblk)
+    else:
+        # dynamic mode: the window [start, start+count) always lies in
+        # [0, R), so the unclamped block start fits and nblk is exactly
+        # the covered block count (>= 1 so the i==0 init always fires)
+        rs_blk = start // Rb
+    off = start - rs_blk * Rb
     last_rel = jnp.maximum(off + count - 1, 0) // Rb
+    if cap is None:
+        nblk = last_rel + 1
     scal = jnp.stack([rs_blk, off, count, last_rel])
+
+    in_specs = [
+        pl.BlockSpec(
+            (SP, Rb),
+            (lambda s, i, scal: (s, scal[0] + jnp.minimum(i, scal[3])))
+            if unroll else
+            (lambda c, i, scal: (c // CC,
+                                 scal[0] + jnp.minimum(i, scal[3])))),
+        # the same gh block is re-fetched once per super-chunk (or per
+        # chunk in grid mode) per row block. Deliberate: the kernel is
+        # one-hot-VPU-bound (~16 us compute vs ~80 ns DMA per step at
+        # Rb=1024), and the alternative — a pre-sliced [2, R] gh
+        # operand — costs an XLA copy of two full planes per call
+        pl.BlockSpec(
+            (8, Rb),
+            lambda s, i, scal: (gh_blk,
+                                scal[0] + jnp.minimum(i, scal[3]))),
+    ]
+    if unroll:
+        grid = (CS, nblk)
+        out_specs = pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
+                                 lambda s, i, scal: (s, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
+                                         jnp.int32 if quant
+                                         else jnp.float32)
+        body = functools.partial(
+            _radix_planar_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
+            bl_bits=bl_bits, dtype=dtype, code_bits=code_bits,
+            gh_off=gh_off, Rb=Rb, SP=SP, quant=quant)
+    else:
+        grid = (CS * CC, nblk)
+        out_specs = pl.BlockSpec((1, 2 * Fc * Bh, Fc * Bl),
+                                 lambda c, i, scal: (c, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((CS * CC, 2 * Fc * Bh, Fc * Bl),
+                                         jnp.int32 if quant
+                                         else jnp.float32)
+        body = functools.partial(
+            _radix_planar_kernel_grid, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
+            bl_bits=bl_bits, dtype=dtype, code_bits=code_bits,
+            gh_off=gh_off, Rb=Rb, SP=SP, quant=quant)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(CS, nblk),
-        in_specs=[
-            pl.BlockSpec(
-                (SP, Rb),
-                lambda s, i, scal: (s, scal[0] + jnp.minimum(i, scal[3]))),
-            # the same gh block is re-fetched once per super-chunk per
-            # row block (index independent of s but s is the outer grid
-            # dim). Deliberate: the kernel is one-hot-VPU-bound (~16 us
-            # compute vs ~80 ns DMA per step at Rb=1024), and the
-            # alternative — a pre-sliced [2, R] gh operand — costs an
-            # XLA copy of two full planes per histogram call
-            pl.BlockSpec(
-                (8, Rb),
-                lambda s, i, scal: (gh_blk,
-                                    scal[0] + jnp.minimum(i, scal[3]))),
-        ],
-        out_specs=pl.BlockSpec((1, CC, 2 * Fc * Bh, Fc * Bl),
-                               lambda s, i, scal: (s, 0, 0, 0)),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[],
     )
     out = pl.pallas_call(
-        functools.partial(_radix_planar_kernel, CC=CC, Fc=Fc, Bh=Bh, Bl=Bl,
-                          bl_bits=bl_bits, dtype=dtype,
-                          code_bits=code_bits, gh_off=gh_off,
-                          Rb=Rb, SP=SP, quant=quant),
+        body,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((CS, CC, 2 * Fc * Bh, Fc * Bl),
-                                       jnp.int32 if quant
-                                       else jnp.float32),
+        out_shape=out_shape,
         interpret=interpret,
     )(scal, data, data)
 

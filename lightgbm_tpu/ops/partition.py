@@ -119,3 +119,24 @@ def next_capacity(count: int, minimum: int = 256) -> int:
     while cap < c:
         cap *= 2
     return cap
+
+
+def capacity_ladder(top: int, base: int, factor: int) -> list:
+    """Geometric capacity ladder [base, base*factor, ...] capped by (and
+    always ending at) ``top`` — the static-capacity buckets shared by
+    the XLA-sliced leaf paths (partition_ref / the row-major histogram
+    bridge), whose window slice width must be a compile-time constant.
+
+    The fused pallas kernels no longer ladder: their block sweeps ride a
+    dynamic grid dimension (ops/plane.py ``cap=None``), so one lowered
+    program serves every leaf size. Every remaining `lax.switch` over
+    this ladder duplicates its branch bodies in the enclosing HLO — keep
+    it off kernel-calling paths (tpulint's recompile-hazard pack flags
+    new ones)."""
+    caps = []
+    c = base
+    while c < top:
+        caps.append(c)
+        c *= factor
+    caps.append(top)
+    return caps

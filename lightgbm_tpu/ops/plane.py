@@ -539,13 +539,24 @@ def _partition_kernel(scal, data_ref, dout_ref, win_ref, nleft_ref,
 @functools.partial(jax.jit,
                    static_argnames=("cap", "layout", "tile", "interpret"))
 def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
-                     rscal, *, cap: int, tile: Optional[int] = None,
+                     rscal, *, cap: Optional[int] = None,
+                     tile: Optional[int] = None,
                      interpret: bool = False):
     """Pallas stable window partition. Returns (data', nleft); data' is
     the SAME buffer, updated in place (input/output aliased).
-    ``tile`` overrides the processing tile (must divide ``cap``; the
-    kernels are per-step-overhead bound, so callers pass bigger tiles
-    for bigger capacity branches)."""
+    ``tile`` overrides the processing tile (the kernels are
+    per-step-overhead bound, so callers pass bigger tiles for bigger
+    windows; with a static ``cap`` the tile must divide it).
+
+    ``cap=None`` (the default) is the dynamic mode: the block sweep
+    rides a DYNAMIC grid dimension sized from the traced window
+    scalars (`t1 + 1` blocks — exactly the covered blocks, so the
+    skipped-step cost model of the old capacity ladder is subsumed: no
+    step is ever launched past the window), and ONE lowered program
+    serves every leaf size. The scratch window is statically sized for
+    the worst case (the whole lane extent), which is what the ladder's
+    top capacity branch already allocated. ``cap=<int>`` keeps the
+    static `cap//S + 1` sweep for shape-stable callers."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     from ..utils.compat import pallas_hbm_space
@@ -553,13 +564,22 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
 
     P, R = data.shape
     S = tile if tile is not None else layout.tile
-    assert cap % S == 0, (cap, S)
-    nt = cap // S + 1
-    wl = nt * S
-    rs_blk = jnp.clip(jnp.asarray(start, jnp.int32) // S, 0, R // S - nt)
-    rs = rs_blk * S
-    off = jnp.asarray(start, jnp.int32) - rs
+    start = jnp.asarray(start, jnp.int32)
     count = jnp.asarray(count, jnp.int32)
+    if cap is not None:
+        assert cap % S == 0, (cap, S)
+        nt = cap // S + 1
+        wl = nt * S
+        rs_blk = jnp.clip(start // S, 0, R // S - nt)
+    else:
+        # the window [start, start+count) always lies in [0, R), so the
+        # unclamped block start fits and every covered block index stays
+        # below R // S
+        assert R % S == 0, (R, S)
+        wl = R
+        rs_blk = start // S
+    rs = rs_blk * S
+    off = start - rs
     t0 = off // S
     t1 = jnp.maximum(off + count - 1, 0) // S
     # kernel scalar layout: [off, count, rs_blk, t0, t1, <10 routing>]
@@ -569,7 +589,7 @@ def partition_pallas(data: jax.Array, layout: PlaneLayout, start, count,
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(3, nt),
+        grid=(3, nt if cap is not None else t1 + 1),
         in_specs=[pl.BlockSpec(
             (P, S),
             lambda side, t, scal: (0, scal[2] + jnp.clip(t, scal[3],
@@ -864,11 +884,15 @@ def _partition_kernel2(scal, data_ref, dout_ref, win_ref, nleft_ref,
 @functools.partial(jax.jit,
                    static_argnames=("cap", "layout", "tile", "interpret"))
 def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
-                      rscal, *, cap: int, tile: Optional[int] = None,
+                      rscal, *, cap: Optional[int] = None,
+                      tile: Optional[int] = None,
                       interpret: bool = False):
     """v2 pallas stable window partition (see _partition_kernel2).
-    Same contract as partition_pallas: returns (data', nleft) with
-    data' the SAME buffer updated in place."""
+    Same contract as partition_pallas — including the ``cap=None``
+    dynamic-grid mode: one lowered program for every leaf size, scratch
+    (and the R-region anchor RB0) statically sized for the whole lane
+    extent. Returns (data', nleft) with data' the SAME buffer updated
+    in place."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     from ..utils.compat import pallas_hbm_space
@@ -876,14 +900,20 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
 
     P, R = data.shape
     S = tile if tile is not None else layout.tile
-    assert cap % S == 0, (cap, S)
-    nt = cap // S + 1
-    wl = nt * S
-    RB0 = wl + S + 256          # R-region anchor inside the scratch
-    rs_blk = jnp.clip(jnp.asarray(start, jnp.int32) // S, 0, R // S - nt)
-    rs = rs_blk * S
-    off = jnp.asarray(start, jnp.int32) - rs
+    start = jnp.asarray(start, jnp.int32)
     count = jnp.asarray(count, jnp.int32)
+    if cap is not None:
+        assert cap % S == 0, (cap, S)
+        nt = cap // S + 1
+        wl = nt * S
+        rs_blk = jnp.clip(start // S, 0, R // S - nt)
+    else:
+        assert R % S == 0, (R, S)
+        wl = R
+        rs_blk = start // S
+    RB0 = wl + S + 256          # R-region anchor inside the scratch
+    rs = rs_blk * S
+    off = start - rs
     t0 = off // S
     t1 = jnp.maximum(off + count - 1, 0) // S
     kern_scal = jnp.concatenate([
@@ -892,7 +922,7 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(2, nt),
+        grid=(2, nt if cap is not None else t1 + 1),
         in_specs=[pl.BlockSpec(
             (P, S),
             # side 1 never reads data_ref: pin its index to block t0 so
@@ -943,10 +973,13 @@ def partition_pallas2(data: jax.Array, layout: PlaneLayout, start, count,
     return dout, nleft[0, 0]
 
 
-def partition_window(data, layout, start, count, rscal, *, cap,
+def partition_window(data, layout, start, count, rscal, *, cap=None,
                      method="auto", tile=None, interpret=False):
     if method == "auto":
         method = "pallas" if jax.default_backend() == "tpu" else "ref"
+    if cap is None and method == "ref":
+        raise ValueError("partition_ref slices with a STATIC capacity — "
+                         "the dynamic cap=None mode is pallas-only")
     if method == "pallas":
         return partition_pallas(data, layout, start, count, rscal,
                                 cap=cap, tile=tile, interpret=interpret)

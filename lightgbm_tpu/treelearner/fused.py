@@ -406,23 +406,25 @@ class FusedSerialGrower:
         # over (set by the data-parallel wrapper; None on one chip)
         self.psum_axis = None
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
-        # capacity ladder for the lax.switch partition/histogram
-        # branches, in lane-tile units. Every switch branch duplicates
-        # its kernels in the while-body HLO, so the ladder factor trades
-        # XLA compile time against window padding; padded blocks outside
-        # the leaf range are SKIPPED by both kernels (index pinned, no
-        # compute/DMA), so a coarse ladder costs only skipped-step
-        # iteration overhead, not bandwidth.
+        # capacity ladder for the REF-path lax.switch branches (the
+        # XLA-sliced partition/histogram fallbacks need a static window
+        # width). The pallas paths no longer ladder: their block sweeps
+        # ride a dynamic grid dimension (ops/plane.py / ops/histogram.py
+        # cap=None), so ONE lowered kernel serves every leaf size, the
+        # while-body HLO holds one copy of each kernel instead of
+        # LGBM_TPU_LADDER x len(caps), and no step is ever launched past
+        # the leaf window (the dynamic sweep subsumes the old
+        # skipped-step cost model). Tile / row-block lengths are fixed
+        # at the top-capacity choice — per-step overhead (~4 us) still
+        # amortizes, small leaves just read one partially-valid block.
         factor = int(np.clip(
             int(os.environ.get("LGBM_TPU_LADDER", 4)), 2, 64))
         tile = self.layout.tile
         top = self.layout.num_lanes - self.layout.max_tile
-        self._caps = []
-        c = tile * 4
-        while c < top:
-            self._caps.append(c)
-            c *= factor
-        self._caps.append(top)
+        from ..ops.partition import capacity_ladder
+        self._caps = capacity_ladder(top, tile * 4, factor)
+        self._dyn_tile = self._branch_tile(top)
+        self._dyn_hist_rb = self._branch_hist_rb(top)
         from ..obs import instrument_kernel
         # jit entry points go through the AOT compile manager
         # (lightgbm_tpu/compile): same-signature growers share one
@@ -562,6 +564,7 @@ class FusedSerialGrower:
             "config": config_signature(self.config),
             "layout": tuple(self.layout),
             "caps": tuple(self._caps),
+            "dyn": (self._dyn_tile, self._dyn_hist_rb),
             "num_features": self.num_features,
             "max_num_bin": self.max_num_bin,
             "group_max_bin": self.group_max_bin,
@@ -663,11 +666,14 @@ class FusedSerialGrower:
         return rb
 
     def _switch_by_cap(self, count, branches_of_cap, *args):
+        """Static-capacity ladder dispatch — REF/row-major paths only
+        (XLA slices need compile-time widths). The pallas kernel paths
+        use the dynamic-grid cap=None mode instead and never ladder."""
         branches = [branches_of_cap(c) for c in self._caps]
         cap_arr = jnp.asarray(self._caps, jnp.int32)
         idx = jnp.searchsorted(cap_arr, jnp.maximum(count, 1))
         idx = jnp.minimum(idx, len(self._caps) - 1)
-        return jax.lax.switch(idx, branches, *args)
+        return jax.lax.switch(idx, branches, *args)  # tpulint: switch-ok(XLA-sliced ref fallback needs static window widths; pallas paths are ladder-free)
 
     def _psum(self, x):
         """Cross-shard sum (reference Network::Allreduce of histogram
@@ -704,7 +710,12 @@ class FusedSerialGrower:
 
     def _leaf_hist_switch(self, data, start, count):
         """Histogram of a leaf range straight off the planar state; the
-        CPU/oracle path goes through the row-major bridge instead."""
+        CPU/oracle path goes through the row-major bridge instead.
+
+        The planar pallas kernel takes the dynamic-grid mode (cap=None):
+        one lowered program for every leaf size, no capacity switch. The
+        row-major bridge keeps the static-capacity ladder — its window
+        slice width is a compile-time constant by construction."""
         Ly = self.layout
         R = Ly.num_lanes
         nbins = (self.group_max_bin if self._efb_hist is not None
@@ -717,17 +728,16 @@ class FusedSerialGrower:
         dtype = (jnp.bfloat16 if self._hist_method == "radix_pallas_bf16"
                  else jnp.float32)
 
-        def branch(cap):
-            rb_br = self._branch_hist_rb(cap)
+        if planar_ok:
+            ghist = H.histogram_planar_pallas(
+                data, start, count, num_bins=nbins,
+                num_cols=Ly.num_cols, code_bits=Ly.code_bits,
+                grad_plane=Ly.grad, cap=None, dtype=dtype,
+                rows_per_block=self._dyn_hist_rb, quant=self._quant)
+            return self._hist_from_groups(ghist)
 
+        def branch(cap):
             def fn(data, start, count):
-                if planar_ok:
-                    ghist = H.histogram_planar_pallas(
-                        data, start, count, num_bins=nbins,
-                        num_cols=Ly.num_cols, code_bits=Ly.code_bits,
-                        grad_plane=Ly.grad, cap=cap, dtype=dtype,
-                        rows_per_block=rb_br, quant=self._quant)
-                    return self._hist_from_groups(ghist)
                 rs = jnp.clip(jnp.asarray(start, jnp.int32), 0, R - cap)
                 codes, gh = plane.window_rowmajor(data, self.layout, rs,
                                                   cap=cap)
@@ -760,13 +770,18 @@ class FusedSerialGrower:
                                     self._efb_dev, is_cat=cat,
                                     cat_bitset=bits)
 
-        def branch(cap):
-            tile_br = self._branch_tile(cap)
+        if self._part_method in ("pallas", "pallas2"):
+            # dynamic-grid partition: one lowered kernel for every leaf
+            # size (ops/plane.py cap=None) — no capacity switch
+            return plane.partition_window(
+                data, self.layout, start, count, rscal, cap=None,
+                method=self._part_method, tile=self._dyn_tile)
 
+        def branch(cap):
             def fn(data, start, count, rscal):
                 return plane.partition_window(
                     data, self.layout, start, count, rscal, cap=cap,
-                    method=self._part_method, tile=tile_br)
+                    method=self._part_method, tile=self._branch_tile(cap))
             return fn
 
         data, nleft = self._switch_by_cap(count, branch, data, start, count,

@@ -681,6 +681,23 @@ class FusedDataParallelGrower(FusedSerialGrower):
                                  * self.num_features * self.max_num_bin
                                  * 2 * 4)
 
+    def _mc_signature(self, extra: Optional[dict] = None):
+        """(sig, shareable) for the top-level shard_map entries. The
+        per-shard fused grower skips manager registration (its programs
+        mutate post-init), but THESE entries are built after that
+        mutation settles, so two MC growers with equal signatures trace
+        identical sharded programs and can share one executable. The
+        bodies close over dataset-derived tables, so the dataset trace
+        signature joins the fused compile signature, as on the serial
+        path."""
+        ds_sig, shareable = self.dataset.trace_signature()
+        sig = self._compile_signature()
+        sig["ds"] = ds_sig
+        sig["mesh"] = (self.num_shards, self.shard_rows, self.global_rows)
+        if extra:
+            sig.update(extra)
+        return sig, shareable
+
     # -- sharded state construction ------------------------------------
     def _shard_lane_pad(self, v, fill=0.0, dtype=jnp.float32):
         """[n] global -> [D * num_lanes] with per-shard lane padding."""
@@ -753,9 +770,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 in_specs=in_specs,
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
-            self._iter_mc_jit = get_manager().jit_entry(
-                "mc/train_iter", jax.jit(f, donate_argnums=0),
-                donate_argnums=(0,))
+            sig, ok = self._mc_signature()
+            self._iter_mc_jit = get_manager().shared_entry(
+                "mc/train_iter", sig,
+                lambda: jax.jit(f, donate_argnums=0),  # tpulint: jit-ok(inside a shared_entry builder; the manager dispatches this jit)
+                donate_argnums=(0,), store=ok)
         args = (data, self._n_per_shard, mask, jnp.float32(shrinkage),
                 jnp.float32(bias))
         if quant:
@@ -798,9 +817,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
                 in_specs=in_specs,
                 out_specs=(P(None, "data"), P()))(body)
             from ..compile import get_manager
-            self._iters_mc_jit_k[k] = get_manager().jit_entry(
-                f"mc/train_iters_k{k}", jax.jit(f, donate_argnums=0),
-                donate_argnums=(0,))
+            sig, ok = self._mc_signature({"k": k})
+            self._iters_mc_jit_k[k] = get_manager().shared_entry(
+                f"mc/train_iters_k{k}", sig,
+                lambda: jax.jit(f, donate_argnums=0),  # tpulint: jit-ok(inside a shared_entry builder; the manager dispatches this jit)
+                donate_argnums=(0,), store=ok)
         args = (data, self._n_per_shard, masks, jnp.float32(shrinkage))
         if quant:
             args = args + (self._next_quant_keys(k),)
@@ -890,7 +911,11 @@ class FusedDataParallelGrower(FusedSerialGrower):
                       P("data", None), P("data", None), P()),
             out_specs=(P(), P("data", None)))(body)
         from ..compile import get_manager
-        return get_manager().jit_entry("mc/grow_tree", jax.jit(f))
+        sig, ok = self._mc_signature()
+        return get_manager().shared_entry(
+            "mc/grow_tree", sig,
+            lambda: jax.jit(f),  # tpulint: jit-ok(inside a shared_entry builder; the manager dispatches this jit)
+            store=ok)
 
     def grow_device(self, grad, hess, perm, bag_cnt,
                     compute_score_update=True):

@@ -123,9 +123,20 @@ class SerialTreeGrower:
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
         self._extra_rng = np.random.RandomState(config.extra_seed)
         from ..compile import get_manager
+        # jit entry points register as SHARED entries keyed by (config,
+        # dataset trace signature): a second grower over a same-structure
+        # dataset dispatches through the first grower's executables —
+        # zero retraces, zero recompiles. The builders close over THIS
+        # instance, which is safe precisely because the signature pins
+        # every closed-over value (signature.py contract). When the
+        # dataset cannot produce a shareable signature the entries fall
+        # back to a per-instance uid and skip the on-disk store.
+        self._shared_sig, self._sig_store = self._serial_signature()
         self._split_jit = instrument_kernel(
-            get_manager().jit_entry("serial/split_scan",
-                                    jax.jit(self._split_packed)),
+            get_manager().shared_entry(
+                "serial/split_scan", self._shared_sig,
+                lambda: jax.jit(self._split_packed),
+                store=self._sig_store),
             "split", name="serial/split_scan")
         self._interaction_sets = _parse_interaction_constraints(
             config.interaction_constraints, dataset)
@@ -199,6 +210,28 @@ class SerialTreeGrower:
             cat = jnp.zeros(2, jnp.int32)
         return vec, ivec, cat
 
+    def _serial_signature(self):
+        """(sig, shareable) — everything that shapes this grower's traced
+        programs besides per-call shapes: the config plus the dataset
+        trace signature (mapper structure, monotone constraints, EFB
+        table contents — io/dataset.py trace_signature). Unlike the
+        fused grower, serial entries CLOSE OVER dataset tables, so the
+        dataset identity must live in the signature, not the args."""
+        from ..compile import config_signature
+        ds_sig, shareable = self.dataset.trace_signature()
+        return {
+            "config": config_signature(self.config),
+            "ds": ds_sig,
+            "num_features": self.num_features,
+            "max_num_bin": self.max_num_bin,
+            "group_max_bin": self.group_max_bin,
+            "any_categorical": self.any_categorical,
+            "use_monotone": self.use_monotone,
+            "split_cfg": self.split_cfg,
+            "efb": self._efb_dev is not None,
+            "efb_hist": self._efb_hist is not None,
+        }, shareable
+
     @functools.lru_cache(maxsize=64)
     def _hist_fn(self, capacity: int):
         B = self.max_num_bin
@@ -206,7 +239,6 @@ class SerialTreeGrower:
         efb_hist = self._efb_hist
         method = H.hist_method(self.config)
 
-        @jax.jit
         def fn(bins, perm, start, count, grad, hess):
             if efb_hist is None:
                 return H.leaf_histogram(bins, perm, start, count, grad, hess,
@@ -219,22 +251,30 @@ class SerialTreeGrower:
             total = ghist[0].sum(axis=0)  # every row in exactly one code
             return per_feature_hist(ghist, efb_hist, total[0], total[1])
         from ..compile import get_manager
+        sig = dict(self._shared_sig, capacity=capacity,
+                   hist_method=method)
         return instrument_kernel(
-            get_manager().jit_entry(f"serial/leaf_histogram_c{capacity}", fn),
+            get_manager().shared_entry("serial/leaf_histogram", sig,
+                                       lambda: jax.jit(fn),
+                                       store=self._sig_store),
             "hist", name="serial/leaf_histogram")
 
     @functools.lru_cache(maxsize=64)
     def _partition_fn(self, capacity: int):
         efb = self._efb_dev
         from ..compile import get_manager
-        pl = get_manager().jit_entry("serial/partition_leaf", partition_leaf)
 
         def fn(bins, perm, start, count, feature, threshold, default_left,
                miss_bin, is_cat, cat_bitset):
-            return pl(bins, perm, start, count, feature,
-                      threshold, default_left, miss_bin, is_cat,
-                      cat_bitset, capacity, efb=efb)
-        return instrument_kernel(fn, "partition", name="serial/partition_leaf")
+            return partition_leaf(bins, perm, start, count, feature,
+                                  threshold, default_left, miss_bin, is_cat,
+                                  cat_bitset, capacity, efb=efb)
+        sig = dict(self._shared_sig, capacity=capacity)
+        entry = get_manager().shared_entry("serial/partition_leaf", sig,
+                                           lambda: jax.jit(fn),
+                                           store=self._sig_store)
+        return instrument_kernel(entry, "partition",
+                                 name="serial/partition_leaf")
 
     # ------------------------------------------------------------------
     def _feature_mask_tree(self) -> np.ndarray:

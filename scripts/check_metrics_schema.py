@@ -23,7 +23,11 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   `pipeline.*` counters, the `stop_check` phase timer, and the
   `overlap_share` / `blocking_syncs_per_iter` bench summary fields;
   v1.8 adds the self-healing `watchdog.*` / `health.*` counters, the
-  `coll.slowest_rank` gauge, and the `sentinel` phase timer),
+  `coll.slowest_rank` gauge, and the `sentinel` phase timer; v1.9 adds
+  the compiled-program accounting — the `compile.programs` /
+  `compile.lowering_s` / `compile.hlo_bytes` counters and the
+  `compile_programs` / `compile_lowering_s` / `compile_hlo_bytes`
+  bench summary fields),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

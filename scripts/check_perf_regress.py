@@ -18,6 +18,11 @@ prediction can't regress predict latency):
 - blocking_syncs_per_iter (runtime blocking host syncs per streamed
   iteration — the async-pipeline gate: a change that re-introduces a
   per-iteration device_get shows up here even when wall time hides it)
+- compile_s        (cold-session XLA compile wall seconds)
+- compile_programs (distinct traced programs compiled cold — the
+  compile-window gate: a change that re-introduces a capacity ladder
+  or splits a shared signature shows up here even when the compile
+  seconds hide it on a fast build machine)
 
 Usage:
     python scripts/check_perf_regress.py FRESH.json [--tol 0.10]
@@ -41,7 +46,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # lower-is-better keys the gate compares
 PERF_KEYS = ("value", "iter_p50_s", "predict_us_per_row",
-             "hot_loop_syncs", "blocking_syncs_per_iter")
+             "hot_loop_syncs", "blocking_syncs_per_iter",
+             "compile_s", "compile_programs")
 
 
 def unwrap(doc: Any) -> Optional[Dict[str, Any]]:

@@ -5,7 +5,18 @@ random codes (no CSR generation, no EFB search — ~2 min instead of
 ~40), then runs a few persistent iterations. Shapes match
 scripts/sparse_scale.py exactly: P=80 planes x 13.37M lanes.
 
-Env: REPRO_ROWS (default 13_200_000), REPRO_COLS (581), REPRO_ITERS (3).
+``--lower-proof`` (or REPRO_MODE=lower) skips training and instead
+proves the compile-window collapse: it traces, lowers, and compiles
+the grid-parameterized planar histogram at the FULL 581-column width
+and fails unless that completes inside REPRO_LOWER_BUDGET_S (default
+300 s). The legacy body unrolled every feature chunk into the kernel,
+and Mosaic lowering of the resulting program took ~70 minutes at this
+width; the grid body is constant-size in the column count (width only
+moves the grid bounds — tests/test_compile_collapse.py pins the
+equation-count claim), so the same lowering is seconds.
+
+Env: REPRO_ROWS (default 13_200_000), REPRO_COLS (581), REPRO_ITERS (3),
+REPRO_LOWER_BUDGET_S (300).
 """
 import os
 import sys
@@ -79,5 +90,52 @@ def main():
     print("OK", flush=True)
 
 
+def lower_proof():
+    """Bounded trace+lower+compile of the full-width histogram program.
+
+    On TPU this is the real Mosaic lowering the 70-minute cliff lived
+    in; on CPU the interpret-mode lowering exercises the same traced
+    program (same equation count, same width-independence). Shapes are
+    abstract — no 13M-row buffer is materialized."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import (histogram_planar_pallas,
+                                            planar_grid_dims)
+
+    code_bits = 4
+    interpret = jax.default_backend() != "tpu"
+    budget = float(os.environ.get("REPRO_LOWER_BUDGET_S", 300))
+    Fc, SP, CC, CS = planar_grid_dims(BINS, code_bits, COLS)
+    gp = -(-CS * SP // 8) * 8
+    R = -(-ROWS // 1024) * 1024
+    print(f"geometry: {COLS} cols -> {CC * CS} feature chunks "
+          f"(Fc={Fc} CC={CC} CS={CS}), R={R}, "
+          f"{'interpret' if interpret else 'mosaic'} lowering", flush=True)
+
+    def fn(d, start, cnt):
+        return histogram_planar_pallas(
+            d, start, cnt, num_bins=BINS, num_cols=COLS,
+            code_bits=code_bits, grad_plane=gp, cap=None,
+            interpret=interpret)
+
+    spec = (jax.ShapeDtypeStruct((gp + 8, R), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32))
+    t0 = time.time()
+    lowered = jax.jit(fn).lower(*spec)
+    t1 = time.time()
+    lowered.compile()
+    t2 = time.time()
+    print(f"lower {t1 - t0:.1f}s  compile {t2 - t1:.1f}s  "
+          f"(budget {budget:.0f}s)", flush=True)
+    assert t2 - t0 < budget, (
+        f"full-width lowering took {t2 - t0:.0f}s > {budget:.0f}s "
+        f"budget — the compile-window cliff is back")
+    print("OK", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if "--lower-proof" in sys.argv or os.environ.get("REPRO_MODE") == "lower":
+        lower_proof()
+    else:
+        main()

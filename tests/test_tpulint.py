@@ -231,6 +231,55 @@ def test_recompile_stale_ignored_and_config_field(tmp_path):
     assert "config-field:verbosity" in codes
 
 
+def test_recompile_switch_ladder_flagged(tmp_path):
+    """PR 10 sub-rule: a lax.switch over a comprehension-built branch
+    ladder clones every branch body into the HLO — the capacity-ladder
+    pattern the dynamic-grid kernels replaced."""
+    pkg = make_pkg(tmp_path, {"mod.py": """\
+        import jax
+
+        def bound_name(idx, args, caps):
+            branches = [make_branch(c) for c in caps]
+            return jax.lax.switch(idx, branches, *args)
+
+        def inline(idx, args, caps):
+            return jax.lax.switch(idx, [make_branch(c) for c in caps],
+                                  *args)
+
+        def make_branch(c):
+            return lambda *a: a
+        """})
+    findings = [f for f in recompile.check(pkg) if f.code == "switch-ladder"]
+    assert sorted(f.func.split("::")[-1] for f in findings) == \
+        ["bound_name", "inline"]
+
+
+def test_recompile_switch_ladder_negatives(tmp_path):
+    """A finite hand-written branch list is fine, and switch-ok
+    documents the deliberate residual ladders (fused.py ref fallback)."""
+    pkg = make_pkg(tmp_path, {"mod.py": """\
+        import jax
+
+        def two_way(pred, x):
+            return jax.lax.switch(pred, [_left, _right], x)
+
+        def annotated(idx, args, caps):
+            branches = [make_branch(c) for c in caps]
+            return jax.lax.switch(idx, branches, *args)  # tpulint: switch-ok(fixture)
+
+        def _left(x):
+            return x
+
+        def _right(x):
+            return x
+
+        def make_branch(c):
+            return lambda *a: a
+        """})
+    assert [f for f in recompile.check(pkg)
+            if f.code == "switch-ladder"] == []
+
+
 def test_lock_discipline_catches_unlocked_mutation(tmp_path):
     pkg = make_pkg(tmp_path, {"mod.py": """\
         import threading
