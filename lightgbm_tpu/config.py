@@ -146,6 +146,7 @@ _ALIASES: Dict[str, str] = {
     "sparse": "is_enable_sparse",
     "is_enable_bundle": "enable_bundle",
     "bundle": "enable_bundle",
+    "max_conflict_rate": "efb_max_conflict_rate",
     "is_pre_partition": "pre_partition",
     "two_round_loading": "two_round",
     "use_two_round_loading": "two_round",
@@ -459,6 +460,14 @@ class Config:
     data_random_seed: int = 1
     is_enable_sparse: bool = True
     enable_bundle: bool = True
+    # EFB bundling budgets (io/efb.py). Wider bundles (fewer groups)
+    # are what the row-wise multival histogram path wants: the per-row
+    # code list shrinks with the group count. Bundle codes widen to
+    # uint16 automatically past 256 bins.
+    efb_max_bundle_bins: int = 256
+    # allowed conflict fraction of the sampled rows per bundle pair
+    # (reference max_conflict_rate); 0 = only provably disjoint merges
+    efb_max_conflict_rate: float = 1.0 / 10000
     use_missing: bool = True
     zero_as_missing: bool = False
     feature_pre_filter: bool = True
@@ -530,6 +539,12 @@ class Config:
     # "float32" (exact inputs; accumulation is always f32 either way).
     # Validated in __post_init__.
     tpu_hist_dtype: str = "bfloat16"
+    # histogram memory layout (ops/histogram.py hist_layout): "auto"
+    # picks per dataset from measured occupancy — the planar one-hot
+    # path for dense-narrow shapes, the row-wise multi-val path
+    # (ops/multival.py, the reference MultiValBin analogue) for
+    # wide-sparse shapes; "planar"/"multival" force one side.
+    tpu_hist_layout: str = "auto"
     tpu_rows_per_chunk: int = 0  # 0 = auto
     # fused single-dispatch tree growth (treelearner/fused.py). True =
     # use it whenever the config is eligible; False = always run the
@@ -652,6 +667,16 @@ class Config:
         if not 4 <= self.num_grad_quant_bins <= 64:
             log.fatal("num_grad_quant_bins must be in [4, 64], got %d",
                       self.num_grad_quant_bins)
+        if self.tpu_hist_layout not in ("auto", "planar", "multival"):
+            log.fatal("tpu_hist_layout must be 'auto', 'planar' or "
+                      "'multival', got %r", self.tpu_hist_layout)
+        if not 2 <= self.efb_max_bundle_bins <= 65536:
+            log.fatal("efb_max_bundle_bins must be in [2, 65536] "
+                      "(uint16 code ceiling), got %d",
+                      self.efb_max_bundle_bins)
+        if not 0.0 <= self.efb_max_conflict_rate < 1.0:
+            log.fatal("efb_max_conflict_rate must be in [0, 1), got %g",
+                      self.efb_max_conflict_rate)
         self.objective = _resolve_objective_name(self.objective)
         self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
                          "goss": "goss", "rf": "rf",

@@ -154,6 +154,10 @@ class BinnedDataset:
         self.bundles: Optional[BundleTables] = None  # None == identity
         self._device_bins = None
         self._monotone_constraints: List[int] = []
+        # construct-time row-occupancy statistics (ops/multival.py
+        # OccupancyStats) driving the planar-vs-multival histogram
+        # layout decision; None until a bin matrix exists
+        self.occupancy = None
 
     # ------------------------------------------------------------------
     @property
@@ -239,6 +243,7 @@ class BinnedDataset:
         self.bins = self.feature_bins()
         self.bundles = None
         self._device_bins = None
+        self._measure_occupancy()  # stats follow the layout change
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -403,8 +408,11 @@ class BinnedDataset:
                 idx, vals = sample_col_nonzeros(f)
                 b = m.values_to_bins(vals)
                 nonzero_rows.append(np.asarray(idx)[b != m.most_freq_bin])
-            ds.bundles = build_bundles(nonzero_rows, ds.bin_mappers,
-                                       sample_cnt, True, bundle_ok=bundle_ok)
+            ds.bundles = build_bundles(
+                nonzero_rows, ds.bin_mappers, sample_cnt, True,
+                bundle_ok=bundle_ok,
+                max_bundle_bins=config.efb_max_bundle_bins,
+                max_conflict_rate=config.efb_max_conflict_rate)
             if ds.bundles.is_trivial:
                 ds.bundles = None
         ds._apply_mappers(data)
@@ -476,6 +484,21 @@ class BinnedDataset:
                     bins[:, g] = code
         self.bins = bins
         self.num_data = n
+        self._measure_occupancy()
+
+    def _measure_occupancy(self) -> None:
+        """Record construct-time row-occupancy statistics (mean/max
+        present codes per row, per-group density, sampled default
+        codes) for the planar-vs-multival histogram layout decision —
+        ops/histogram.py hist_layout(). Sampled and cheap; runs on
+        every construction path (from_matrix, create_valid reference,
+        load_binary) so the stats always match the current bin
+        matrix."""
+        self.occupancy = None
+        if self.bins is None or self.bins.size == 0:
+            return
+        from ..ops.multival import measure_occupancy
+        self.occupancy = measure_occupancy(self.bins)
 
     # ------------------------------------------------------------------
     def create_valid(self, data: np.ndarray, label=None, weight=None,
@@ -525,6 +548,26 @@ class BinnedDataset:
                     for a in (bt.group_of, bt.offset_of, bt.nslots_of,
                               bt.skip_of, bt.group_num_bins):
                         h.update(np.ascontiguousarray(a).tobytes())
+                occ = self.occupancy
+                if occ is not None:
+                    # DERIVED discrete occupancy values only (never the
+                    # raw float stats — jittery means must not fracture
+                    # the AOT key space): the bucketed row capacity
+                    # shapes the multival planes, the wide-sparse bool
+                    # is the auto layout decision, and the sampled
+                    # default codes are closed over by serial multival
+                    # entries (ops/multival.py group tables)
+                    from ..ops.multival import (
+                        bucket_row_capacity, MULTIVAL_MIN_GROUPS,
+                        MULTIVAL_MAX_OCCUPANCY)
+                    wide = (occ.num_groups >= MULTIVAL_MIN_GROUPS
+                            and occ.row_nnz_mean
+                            <= MULTIVAL_MAX_OCCUPANCY * occ.num_groups)
+                    h.update(("mv:%d,%d;" % (
+                        bucket_row_capacity(occ.row_nnz_max),
+                        int(wide))).encode())
+                    h.update(np.ascontiguousarray(
+                        occ.default_code).tobytes())
                 self._trace_sig = ("ds-" + h.hexdigest()[:20], True)
             except Exception:
                 self._trace_sig = ("uid-%x" % id(self), False)
@@ -603,6 +646,7 @@ class BinnedDataset:
             if header["has_init_score"]:
                 sn = int.from_bytes(fh.read(8), "little")
                 ds.metadata.init_score = np.frombuffer(fh.read(8 * sn), dtype=np.float64).copy()
+        ds._measure_occupancy()
         return ds
 
 

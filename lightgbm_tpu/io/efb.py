@@ -36,14 +36,16 @@ import numpy as np
 from ..utils import log
 from .binning import BIN_CATEGORICAL
 
-MAX_BUNDLE_BINS = 256          # keeps bundle codes uint8
+MAX_BUNDLE_BINS = 256          # default: keeps bundle codes uint8
 MAX_SEARCH_GROUP = 100         # reference dataset.cpp:105 max_search_group
 CONFLICT_FRACTION = 1.0 / 10000  # reference single_val_max_conflict_cnt
 
 
 def find_bundles(nonzero_rows: List[np.ndarray], num_bins: Sequence[int],
                  bundle_ok: Sequence[bool], sample_cnt: int,
-                 max_bundle_bins: int = MAX_BUNDLE_BINS) -> List[List[int]]:
+                 max_bundle_bins: int = MAX_BUNDLE_BINS,
+                 max_conflict_rate: float = CONFLICT_FRACTION
+                 ) -> List[List[int]]:
     """Greedy conflict-bounded grouping of features into bundles.
 
     nonzero_rows[f]: sorted sample-row indices where feature f is NOT at
@@ -54,10 +56,14 @@ def find_bundles(nonzero_rows: List[np.ndarray], num_bins: Sequence[int],
     Mirrors reference FindGroups (dataset.cpp:96): features are visited
     in descending non-default count, a feature joins the first existing
     group whose accumulated conflict count stays within
-    sample_cnt/10000, else opens a new group.
+    sample_cnt * max_conflict_rate, else opens a new group. Both budgets
+    are config knobs (efb_max_bundle_bins / efb_max_conflict_rate):
+    denser bundling — wider groups, uint16 codes past 256 bins — is the
+    lever the row-wise multival histogram layout wants, since its
+    per-row code list shrinks with the group count.
     """
     f_total = len(nonzero_rows)
-    max_conflict = int(sample_cnt * CONFLICT_FRACTION)
+    max_conflict = int(sample_cnt * max_conflict_rate)
     order = sorted(range(f_total), key=lambda f: -len(nonzero_rows[f]))
 
     group_members: List[List[int]] = []
@@ -265,12 +271,17 @@ def bundle_eligible(m) -> bool:
 
 def build_bundles(nonzero_rows: List[np.ndarray], mappers,
                   sample_cnt: int, enable: bool,
-                  bundle_ok: Optional[Sequence[bool]] = None) -> BundleTables:
+                  bundle_ok: Optional[Sequence[bool]] = None,
+                  max_bundle_bins: int = MAX_BUNDLE_BINS,
+                  max_conflict_rate: float = CONFLICT_FRACTION
+                  ) -> BundleTables:
     """Decide bundling from per-feature sampled non-default row sets.
 
     nonzero_rows[f]: sample-row indices where feature f's bin != its
     most-frequent bin (empty for ineligible features). Returns identity
-    tables when bundling is disabled or not profitable.
+    tables when bundling is disabled or not profitable. Codes are uint8
+    while every group fits 256 bins and widen to uint16 past that
+    (io/dataset.py _apply_mappers picks the dtype off group_num_bins).
     """
     num_bins = [m.num_bin for m in mappers]
     f_total = len(mappers)
@@ -278,7 +289,9 @@ def build_bundles(nonzero_rows: List[np.ndarray], mappers,
         return BundleTables.identity(num_bins)
     if bundle_ok is None:
         bundle_ok = [bundle_eligible(m) for m in mappers]
-    groups = find_bundles(nonzero_rows, num_bins, bundle_ok, sample_cnt)
+    groups = find_bundles(nonzero_rows, num_bins, bundle_ok, sample_cnt,
+                          max_bundle_bins=max_bundle_bins,
+                          max_conflict_rate=max_conflict_rate)
     if len(groups) >= f_total:
         return BundleTables.identity(num_bins)
     mfb = [m.most_freq_bin for m in mappers]

@@ -75,8 +75,12 @@ SCHEMA_VERSION = 1
 # (sub-LGBM_TPU_AOT_MIN_COMPILE_S compiles skip the stat) under
 # `counters`, plus the
 # compile_programs / compile_lowering_s / compile_hlo_bytes bench
-# summary fields)
-SCHEMA_MINOR = 9
+# summary fields), to 10 when the multi-value histogram layout fields
+# joined (hist.multival_rows packed-row counter and the
+# hist.layout_planar / hist.layout_multival dispatch counters under
+# `counters`, the hist.row_nnz_mean occupancy gauge, plus the
+# row_nnz_mean / hist_layout bench summary fields)
+SCHEMA_MINOR = 10
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -99,10 +103,13 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "overlap_share", "blocking_syncs_per_iter",
                        # compiled-program accounting (schema minor 9)
                        "compile_programs", "compile_lowering_s",
-                       "compile_hlo_bytes")
+                       "compile_hlo_bytes",
+                       # multival layout occupancy (schema minor 10)
+                       "row_nnz_mean")
 # optional string-typed bench keys (minor 2): histogram kernel variant;
-# (minor 5): runtime trace output path
-_BENCH_OPTIONAL_STR = ("hist_method", "trace_file")
+# (minor 5): runtime trace output path; (minor 10): histogram layout
+# decision ("planar" | "multival")
+_BENCH_OPTIONAL_STR = ("hist_method", "trace_file", "hist_layout")
 
 
 def _num_map_problems(rec: Dict[str, Any], key: str,

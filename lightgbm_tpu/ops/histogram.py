@@ -718,23 +718,81 @@ def _use_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def hist_method(config) -> Optional[str]:
+def hist_layout(config, dataset=None) -> str:
+    """Occupancy-driven histogram LAYOUT decision: "planar" (column
+    bin-plane kernels) or "multival" (row-wise packed present-code
+    kernels, ops/multival.py). Pure function of config + the dataset's
+    construct-time occupancy statistics — no backend check, so tests
+    exercise it on CPU and the decision folds into AOT signatures.
+
+    ``tpu_hist_layout`` overrides; "auto" picks multival exactly when
+    the shape is wide AND sparse: measured occupancy exists, the group
+    count clears MULTIVAL_MIN_GROUPS (narrow shapes like HIGGS keep the
+    planar kernel — its per-plane pass is already cheap), and the mean
+    present-codes-per-row is at most MULTIVAL_MAX_OCCUPANCY of the
+    group count (the multival gather does K*T MAC work per row vs the
+    planar kernel's T — it only wins when K << G)."""
+    from .multival import MULTIVAL_MIN_GROUPS, MULTIVAL_MAX_OCCUPANCY
+    if config.tpu_hist_layout != "auto":
+        return config.tpu_hist_layout
+    occ = getattr(dataset, "occupancy", None) if dataset is not None \
+        else None
+    if (occ is not None and occ.num_groups >= MULTIVAL_MIN_GROUPS
+            and occ.row_nnz_mean
+            <= MULTIVAL_MAX_OCCUPANCY * occ.num_groups):
+        return "multival"
+    return "planar"
+
+
+def _note_layout(layout: str, occ) -> None:
+    """Telemetry: which layout the dispatcher picked, and the measured
+    occupancy behind the decision (obs schema minor 10)."""
+    from ..obs import active
+    reg = active()
+    if reg is None:
+        return
+    reg.inc(f"hist.layout_{layout}")
+    if occ is not None:
+        reg.set_gauge("hist.row_nnz_mean", float(occ.row_nnz_mean))
+
+
+def hist_method(config, dataset=None) -> Optional[str]:
     """The ONE backend/dtype histogram dispatch, shared by every learner
     (serial, host-loop parallel, fused) — they must agree on histogram
     precision or their trees diverge beyond f32 noise. On TPU: the
-    pallas radix kernel, bfloat16 inputs by default (the reference GPU
-    learner's single-precision histograms, gpu_use_dp=false —
-    AUC-neutral, 2x MXU rate) or float32 per tpu_hist_dtype. Other
-    backends keep the exact scatter path (the oracle) regardless."""
-    if _use_tpu():
-        return ("radix_pallas" if config.tpu_hist_dtype == "float32"
-                else "radix_pallas_bf16")
-    return None
+    pallas radix kernel over the planar layout, bfloat16 inputs by
+    default (the reference GPU learner's single-precision histograms,
+    gpu_use_dp=false — AUC-neutral, 2x MXU rate) or float32 per
+    tpu_hist_dtype; or "multival_pallas" when hist_layout() picks the
+    row-wise multi-value layout for this dataset (wide-sparse shapes —
+    requires the dataset handle with construct-time occupancy stats;
+    callers without one, e.g. the host-loop parallel learners, keep
+    planar). Other backends keep the exact scatter path (the oracle)
+    regardless. Note "multival_pallas" does NOT encode a dtype suffix:
+    the multival kernels read precision from tpu_hist_dtype directly."""
+    if not _use_tpu():
+        return None
+    occ = getattr(dataset, "occupancy", None) if dataset is not None \
+        else None
+    layout = hist_layout(config, dataset)
+    if layout == "multival" and occ is not None:
+        _note_layout("multival", occ)
+        return "multival_pallas"
+    _note_layout("planar", occ)
+    return ("radix_pallas" if config.tpu_hist_dtype == "float32"
+            else "radix_pallas_bf16")
 
 
 def histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               num_bins: int, method: Optional[str] = None) -> jax.Array:
     """Backend-dispatched histogram [F, B, 2]."""
+    if method == "multival_pallas":
+        # the multival kernels take packed row-wise codes, not [n, F]
+        # bin matrices — learners route them through ops/multival.py
+        # entry points, never through this column-major dispatch
+        raise ValueError(
+            "multival_pallas is not a column-major histogram method; "
+            "use ops.multival.leaf_histogram_multival")
     if method is None:
         method = "radix_pallas" if _use_tpu() else "scatter"
     if method == "radix_pallas":

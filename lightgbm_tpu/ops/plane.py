@@ -97,12 +97,20 @@ class PlaneLayout(NamedTuple):
     max_tile: int        # largest per-branch processing tile the lane
                          # padding supports (power-of-2 multiple of
                          # tile, <= MAX_TILE, scaled to the row count)
+    # row-wise multival code planes (ops/multival.py): K slot planes of
+    # int32 flat codes appended after the scalar planes so the
+    # partition kernels keep them row-aligned for free. Trailing
+    # defaults keep every existing constructor/signature working.
+    mv_start: int = -1   # first mv plane (8-aligned), -1 when absent
+    mv_planes: int = 0   # K rounded up to the 8-sublane tile
 
 
 def make_layout(num_cols: int, code_bits: int, n: int,
                 with_label: bool = False, with_score: bool = False,
-                with_weight: bool = False, tile: int = DEF_TILE) -> PlaneLayout:
+                with_weight: bool = False, tile: int = DEF_TILE,
+                mv_planes: int = 0) -> PlaneLayout:
     assert code_bits in (4, 8, 16)
+    assert mv_planes % 8 == 0, mv_planes
     cp = -(-num_cols * code_bits // 32)
     p = cp
     if p % 8 == 7:
@@ -124,6 +132,13 @@ def make_layout(num_cols: int, code_bits: int, n: int,
     if with_weight:
         weight = p
         p += 1
+    mv_start = -1
+    if mv_planes:
+        # mv code planes start 8-aligned: the multival histogram kernel
+        # reads them as (8, Rb) tile-aligned BlockSpecs
+        p = -(-p // 8) * 8
+        mv_start = p
+        p += mv_planes
     num_planes = -(-p // 8) * 8
     # lane padding sized for the LARGEST per-branch processing tile:
     # kernels are per-step-overhead bound, so big leaf windows process
@@ -135,7 +150,7 @@ def make_layout(num_cols: int, code_bits: int, n: int,
     num_lanes = (-(-n // max_tile) + 1) * max_tile
     return PlaneLayout(num_cols, code_bits, cp, grad, hess, rowid,
                        label, score, weight, num_planes, n, num_lanes,
-                       tile, max_tile)
+                       tile, max_tile, mv_start, mv_planes)
 
 
 def f32_as_i32(x):
@@ -227,9 +242,13 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
                rowid: Optional[jax.Array] = None,
                label: Optional[jax.Array] = None,
                score: Optional[jax.Array] = None,
-               weight: Optional[jax.Array] = None) -> jax.Array:
+               weight: Optional[jax.Array] = None,
+               mv: Optional[jax.Array] = None) -> jax.Array:
     """Assemble the [P, R] planar state. grad/hess/... are [n] f32 in
-    lane order (already permuted if a bagging permutation applies)."""
+    lane order (already permuted if a bagging permutation applies).
+    ``mv``: [mv_planes, n|R] int32 slot-major row-wise codes
+    (ops/multival.py) when the layout reserves mv planes — pad lanes
+    are filled with the −1 no-contribution code."""
     R = layout.num_lanes
     n = grad.shape[0]
 
@@ -258,7 +277,20 @@ def build_data(layout: PlaneLayout, codes_planes: jax.Array,
             v = val if val is not None else jnp.zeros(n, jnp.float32)
             extra.append(f32_as_i32(lane_pad_f(v))[None])
     rows.append(jnp.concatenate(extra, axis=0))
-    pad = layout.num_planes - layout.grad - len(extra)
+    p_used = layout.grad + len(extra)
+    if layout.mv_planes:
+        assert mv is not None and mv.shape[0] == layout.mv_planes, \
+            (None if mv is None else mv.shape, layout.mv_planes)
+        gap_mv = layout.mv_start - p_used
+        if gap_mv:
+            rows.append(jnp.zeros((gap_mv, R), jnp.int32))
+        m = mv.astype(jnp.int32)
+        if m.shape[1] < R:
+            m = jnp.pad(m, ((0, 0), (0, R - m.shape[1])),
+                        constant_values=-1)
+        rows.append(m)
+        p_used = layout.mv_start + layout.mv_planes
+    pad = layout.num_planes - p_used
     if pad:
         rows.append(jnp.zeros((pad, R), jnp.int32))
     return jnp.concatenate(rows, axis=0)

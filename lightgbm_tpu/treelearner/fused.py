@@ -256,9 +256,12 @@ class FusedSerialGrower:
         self._tables_cache = None
         self.group_max_bin = dataset.group_max_bins
         # backend dispatch: ops/histogram.hist_method is the ONE shared
-        # precision choice for every learner; partition follows suit
-        # (LGBM_TPU_PART selects the carry-stream kernel generation)
-        self._hist_method = H.hist_method(config)
+        # precision/layout choice for every learner; partition follows
+        # suit (LGBM_TPU_PART selects the carry-stream kernel
+        # generation). The dataset argument lets the occupancy-driven
+        # dispatcher pick the row-wise multival layout for wide-sparse
+        # shapes (ops/multival.py).
+        self._hist_method = H.hist_method(config, dataset)
         self._part_method = (os.environ.get("LGBM_TPU_PART", "pallas2")
                              if self._hist_method is not None else "ref")
         # quantized-gradient training (ops/quantize.py): the persistent
@@ -302,11 +305,36 @@ class FusedSerialGrower:
                    and objective.num_tree_per_iteration == 1)
         has_w = persist and objective.persistent_aux()[1] is not None
 
+        # row-wise multival layout (ops/multival.py): the dataset's
+        # present (group, bin) codes are packed once into [K, N] slot
+        # planes that ride the planar state (make_layout mv_planes), so
+        # the partition kernels keep them row-aligned for free and the
+        # histogram pass reads K*4 bytes/row instead of G code bytes
+        self._mv_layout = None
+        self._mv_total_bins = 0
+        self._mv_dev = None
+        self._mv_tables = None
+        mv_planes = 0
+        if self._hist_method == "multival_pallas":
+            from ..ops import multival as MV
+            occ = dataset.occupancy
+            if dataset.bundles is not None:
+                gnb = dataset.bundles.group_num_bins
+            else:
+                gnb = np.asarray([m.num_bin for m in mappers], np.int32)
+            mv_codes, mv_layout = MV.build_rowwise_codes(
+                dataset.bins, gnb, occ.default_code)
+            self._mv_layout = mv_layout
+            self._mv_total_bins = mv_layout.total_bins
+            self._mv_dev = jnp.asarray(np.ascontiguousarray(mv_codes.T))
+            self._mv_tables = MV.group_tables(gnb, occ.default_code)
+            mv_planes = mv_layout.row_capacity   # a multiple of 8
+
         def mk_layout(tile):
             return plane.make_layout(
                 self._num_cols, self._code_bits, n,
                 with_label=persist, with_score=persist, with_weight=has_w,
-                tile=tile)
+                tile=tile, mv_planes=mv_planes)
 
         self.layout = mk_layout(plane.DEF_TILE)
         # scoped-VMEM budgeting: every partition staging buffer spans
@@ -520,6 +548,7 @@ class FusedSerialGrower:
                 "miss": self.feature_miss_bin,
                 "efb": self._efb_dev,
                 "efb_hist": self._efb_hist,
+                "mv": self._mv_tables,
             }
             # canonicalize scalar leaves (e.g. the EFB hist_tables' bg
             # int) to arrays so warmup specs can take avals of every
@@ -538,7 +567,7 @@ class FusedSerialGrower:
         from ..compile import get_manager
         with get_manager()._trace_lock:
             saved = (self.meta, self.feature_miss_bin, self._efb_dev,
-                     self._efb_hist)
+                     self._efb_hist, self._mv_tables)
             m = tables["meta"]
             self.meta = S.FeatureMeta(
                 num_bin=m["num_bin"], missing_type=m["missing_type"],
@@ -549,11 +578,12 @@ class FusedSerialGrower:
             self.feature_miss_bin = tables["miss"]
             self._efb_dev = tables["efb"]
             self._efb_hist = tables["efb_hist"]
+            self._mv_tables = tables.get("mv")
             try:
                 yield
             finally:
                 (self.meta, self.feature_miss_bin, self._efb_dev,
-                 self._efb_hist) = saved
+                 self._efb_hist, self._mv_tables) = saved
 
     def _compile_signature(self) -> Dict:
         """Everything that shapes the traced programs EXCEPT the table
@@ -573,6 +603,7 @@ class FusedSerialGrower:
             "use_monotone": self.use_monotone,
             "cat_idx": tuple(self.meta.cat_idx),
             "hist_method": self._hist_method,
+            "mv_total_bins": self._mv_total_bins,
             "part_method": self._part_method,
             "use_hist_pool": self._use_hist_pool,
             "score_from_partition": self._score_from_partition,
@@ -587,11 +618,11 @@ class FusedSerialGrower:
 
     def _entry_grow_tree(self, tables, codes_planes, grad, hess, perm,
                          bag_cnt, feature_mask, bins_rowmajor=None,
-                         compute_score_update: bool = True):
+                         mv=None, compute_score_update: bool = True):
         with self._bind_tables(tables):
             return self._grow_tree(codes_planes, grad, hess, perm,
                                    bag_cnt, feature_mask, bins_rowmajor,
-                                   compute_score_update)
+                                   mv, compute_score_update)
 
     def _entry_train_iter(self, tables, data, feature_mask, shrinkage,
                           bias, n_valid, key=None):
@@ -634,9 +665,11 @@ class FusedSerialGrower:
             cp_aval = aval((Ly.code_planes, Ly.num_lanes), jnp.int32)
             fvec = aval((n,), jnp.float32)
             perm_aval = aval((Ly.num_rows,), jnp.int32)
+            mv_aval = (aval(self._mv_dev.shape, jnp.int32)
+                       if self._mv_dev is not None else None)
             self._grow_entry.add_spec(
                 (t_avals, cp_aval, fvec, fvec, perm_aval, i32s, mask_aval,
-                 None), {"compute_score_update": True})
+                 None, mv_aval), {"compute_score_update": True})
 
     def _branch_tile(self, cap: int) -> int:
         """Per-branch partition processing tile: the kernels are
@@ -728,6 +761,9 @@ class FusedSerialGrower:
         dtype = (jnp.bfloat16 if self._hist_method == "radix_pallas_bf16"
                  else jnp.float32)
 
+        if self._hist_method == "multival_pallas":
+            return self._leaf_hist_multival(data, start, count)
+
         if planar_ok:
             ghist = H.histogram_planar_pallas(
                 data, start, count, num_bins=nbins,
@@ -760,6 +796,30 @@ class FusedSerialGrower:
             return fn
 
         return self._switch_by_cap(count, branch, data, start, count)
+
+    def _leaf_hist_multival(self, data, start, count, interpret=False):
+        """Leaf histogram off the row-wise multi-value planes (wide-
+        sparse shape): the kernel accumulates a flat [T+1, 2] pair
+        vector over present codes only, then per-group rows are gathered
+        back and the absent default cell of each group is reconstructed
+        from the sentinel leaf totals (flat cell T)."""
+        from ..ops import multival as MV
+        Ly = self.layout
+        dtype = (jnp.bfloat16
+                 if self.config.tpu_hist_dtype == "bfloat16"
+                 else jnp.float32)
+        flat = MV.histogram_multival_planar(
+            data, start, count,
+            mv_start=Ly.mv_start, mv_planes=Ly.mv_planes,
+            total_bins=self._mv_total_bins, grad_plane=Ly.grad,
+            dtype=dtype, rows_per_block=self._dyn_hist_rb,
+            quant=self._quant, interpret=interpret)
+        ghist = MV.group_hist_from_flat(flat, self._mv_tables)
+        if self._efb_hist is None:
+            return ghist
+        from ..io.efb import per_feature_hist
+        total = flat[-1]
+        return per_feature_hist(ghist, self._efb_hist, total[0], total[1])
 
     def _split_step(self, data, start, count, feature, thr, dl, miss_bin,
                     cat=None, bits=None):
@@ -1469,17 +1529,19 @@ class FusedSerialGrower:
 
     # ------------------------------------------------------------------
     def _grow_tree(self, codes_planes, grad, hess, perm, bag_cnt,
-                   feature_mask, bins_rowmajor=None,
+                   feature_mask, bins_rowmajor=None, mv=None,
                    compute_score_update: bool = True):
         """Per-tree program for the non-persistent path. Returns
         (tree arrays dict, leaf_of_row [n] in ORIGINAL row order or
         None). ``bins_rowmajor`` is passed as a jit ARGUMENT on the
         bagging path — a self.bins closure would embed the full bin
         matrix as an HLO constant (hundreds of MB at HIGGS scale, which
-        overflows remote-compile request limits)."""
+        overflows remote-compile request limits). ``mv``: slot-major
+        [K, n] multi-value code planes, already in the same lane order
+        as ``codes_planes`` (bag-permuted on the bagging path)."""
         n = self.layout.num_rows
         data = plane.build_data(self.layout, codes_planes, grad, hess,
-                                rowid=perm)
+                                rowid=perm, mv=mv)
         ta, st = self._grow_tree_core(data, bag_cnt, feature_mask)
 
         leaf_of_row = None
@@ -1501,6 +1563,7 @@ class FusedSerialGrower:
             perm_dev = jnp.arange(self.layout.num_rows, dtype=jnp.int32)
             g, h = grad, hess
             bins_arg = None
+            mv_arg = self._mv_dev
         else:
             # bagging: one row gather per TREE (not per split) to build
             # the bag-ordered planar pack
@@ -1508,9 +1571,12 @@ class FusedSerialGrower:
             cp = plane.build_codes_planes(self.bins[perm_dev], self.layout)
             g, h = grad[perm_dev], hess[perm_dev]
             bins_arg = self.bins
+            mv_arg = (None if self._mv_dev is None
+                      else self._mv_dev[:, perm_dev])
         ta, leaf = self._grow_jit(self._tables(), cp, g, h, perm_dev,
                                   jnp.int32(bag_cnt),
                                   self.feature_masks_for_tree(), bins_arg,
+                                  mv_arg,
                                   compute_score_update=compute_score_update)
         if leaf is not None and leaf.shape[0] != self.actual_rows:
             # row-bucketed layout: pad lanes scattered into positions
@@ -1531,7 +1597,8 @@ class FusedSerialGrower:
             label=jnp.asarray(aux_label, jnp.float32),
             score=jnp.asarray(score_vec, jnp.float32),
             weight=(None if aux_weight is None
-                    else jnp.asarray(aux_weight, jnp.float32)))
+                    else jnp.asarray(aux_weight, jnp.float32)),
+            mv=self._mv_dev)
         # the persistent program carries the codes INSIDE `data`; the
         # cached planes copy would sit in HBM for nothing (3.9 GB at
         # the Allstate shape, next to the state and the partition
@@ -1722,8 +1789,10 @@ class FusedSerialGrower:
         wgt = None if aux_weight is None \
             else jnp.asarray(aux_weight, jnp.float32)[rid_n]
         zeros = jnp.zeros(n, jnp.float32)
+        mv = None if self._mv_dev is None else self._mv_dev[:, rid_n]
         data = plane.build_data(Ly, cp, zeros, zeros, rowid=rid,
-                                label=lab, score=zeros, weight=wgt)
+                                label=lab, score=zeros, weight=wgt,
+                                mv=mv)
         data = data.at[Ly.score].set(
             jnp.asarray(np.asarray(score_bits, np.int32)))
         self._codes_planes_dev = None

@@ -130,6 +130,9 @@ class DataParallelTreeGrower(SerialTreeGrower):
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
         mesh = self.mesh
+        # no dataset handle: the host-loop parallel learners always take
+        # the planar/radix kernels (the multival layout is a serial- and
+        # fused-learner path; see ops/histogram.py hist_method)
         method = H.hist_method(self.config)
 
         @jax.jit

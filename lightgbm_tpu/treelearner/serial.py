@@ -166,6 +166,7 @@ class SerialTreeGrower:
         # quantized-gradient training (ops/quantize.py): per-tree scales
         # of the current iteration, None on the f32 path
         self._quant = bool(config.use_quantized_grad)
+        self._mv_state = None  # lazy multival view (see _multival_state)
         self._qscales = None
         self._quant_tree_idx = 0
         self._quant_prefetch = Q.PrefetchedQuant()
@@ -232,24 +233,68 @@ class SerialTreeGrower:
             "efb_hist": self._efb_hist is not None,
         }, shareable
 
+    def _multival_state(self):
+        """Lazily built row-wise multi-value view of the dataset
+        (ops/multival.py): (codes [n, K] device, total_bins, group
+        tables). Only materialized when hist_method picked the multival
+        layout for this dataset; like the other serial entries the
+        tables are CLOSED OVER — the dataset identity in _shared_sig
+        pins them."""
+        if self._mv_state is None:
+            from ..ops import multival as MV
+            ds = self.dataset
+            occ = ds.occupancy
+            if ds.bundles is not None:
+                gnb = ds.bundles.group_num_bins
+            else:
+                gnb = np.asarray([m.num_bin for m in ds.bin_mappers],
+                                 np.int32)
+            codes, lay = MV.build_rowwise_codes(ds.bins, gnb,
+                                                occ.default_code)
+            self._mv_state = (jnp.asarray(codes), lay.total_bins,
+                              MV.group_tables(gnb, occ.default_code))
+        return self._mv_state
+
     @functools.lru_cache(maxsize=64)
     def _hist_fn(self, capacity: int):
         B = self.max_num_bin
         Bg = self.group_max_bin
         efb_hist = self._efb_hist
-        method = H.hist_method(self.config)
+        method = H.hist_method(self.config, self.dataset)
 
-        def fn(bins, perm, start, count, grad, hess):
-            if efb_hist is None:
-                return H.leaf_histogram(bins, perm, start, count, grad, hess,
-                                        capacity, B, method=method)
-            # bundle-space histogram over G << F columns, then gather to
-            # per-feature space with FixHistogram mfb reconstruction
-            from ..io.efb import per_feature_hist
-            ghist = H.leaf_histogram(bins, perm, start, count, grad, hess,
-                                     capacity, Bg, method=method)
-            total = ghist[0].sum(axis=0)  # every row in exactly one code
-            return per_feature_hist(ghist, efb_hist, total[0], total[1])
+        if method == "multival_pallas":
+            from ..ops import multival as MV
+            codes_dev, total_bins, tables = self._multival_state()
+
+            def fn(bins, perm, start, count, grad, hess):
+                # ``bins`` ignored: the multival path reads the packed
+                # present-code view instead of the [n, G] bin matrix
+                flat = MV.leaf_histogram_multival(
+                    codes_dev, perm, start, count, grad, hess,
+                    capacity, total_bins)
+                ghist = MV.group_hist_from_flat(flat, tables)
+                if efb_hist is None:
+                    return ghist
+                from ..io.efb import per_feature_hist
+                total = flat[-1]
+                return per_feature_hist(ghist, efb_hist, total[0],
+                                        total[1])
+        else:
+            def fn(bins, perm, start, count, grad, hess):
+                if efb_hist is None:
+                    return H.leaf_histogram(bins, perm, start, count,
+                                            grad, hess, capacity, B,
+                                            method=method)
+                # bundle-space histogram over G << F columns, then gather
+                # to per-feature space with FixHistogram mfb
+                # reconstruction
+                from ..io.efb import per_feature_hist
+                ghist = H.leaf_histogram(bins, perm, start, count, grad,
+                                         hess, capacity, Bg,
+                                         method=method)
+                total = ghist[0].sum(axis=0)  # every row in one code
+                return per_feature_hist(ghist, efb_hist, total[0],
+                                        total[1])
         from ..compile import get_manager
         sig = dict(self._shared_sig, capacity=capacity,
                    hist_method=method)

@@ -27,12 +27,16 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   the compiled-program accounting — the `compile.programs` /
   `compile.lowering_s` / `compile.hlo_bytes` counters and the
   `compile_programs` / `compile_lowering_s` / `compile_hlo_bytes`
-  bench summary fields),
+  bench summary fields; v1.10 adds the multi-value histogram layout
+  fields — the `hist.multival_rows` / `hist.layout_planar` /
+  `hist.layout_multival` counters, the `hist.row_nnz_mean` gauge, and
+  the `row_nnz_mean` / `hist_layout` bench summary fields),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py
-  may also write a BENCH_BIN63 sidecar (max_bin=63 config) — same
-  schema, validated the same way.
+  may also write a BENCH_BIN63 sidecar (max_bin=63 config) or a
+  BENCH_WIDE sidecar (wide-sparse multival shape) — same schema,
+  validated the same way.
 
 Usage:
     python scripts/check_metrics_schema.py [FILE ...]

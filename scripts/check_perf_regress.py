@@ -27,6 +27,16 @@ prediction can't regress predict latency):
 Usage:
     python scripts/check_perf_regress.py FRESH.json [--tol 0.10]
         [--baseline BENCH_rNN.json]
+        [--wide-fresh BENCH_WIDE.json [--wide-baseline OLD_WIDE.json]]
+
+The wide-sparse shape gates separately: --wide-fresh compares a fresh
+BENCH_WIDE.json sidecar (bench.py run_wide_sidecar) against
+--wide-baseline, defaulting to the committed BENCH_WIDE.json in the
+repo root when one exists — so a change that silently flips the
+occupancy dispatcher back to the planar layout (or slows the multival
+kernel) fails the gate even while the dense-narrow headline number is
+untouched. Same PERF_KEYS, same tolerance; additionally FAILS when the
+baseline's hist_layout was "multival" and the fresh run's is not.
 
 Wired into scripts/ci_static.sh behind PERF_REGRESS_BENCH=FRESH.json
 (opt-in: the static lane has no TPU to produce a fresh bench line).
@@ -105,6 +115,51 @@ def compare(fresh: Dict[str, Any], base: Dict[str, Any],
     return regressions, lines
 
 
+def gate_wide(fresh_path: str, base_path: Optional[str],
+              tol: float) -> int:
+    """Wide-sparse sidecar gate (0 = pass). Separate from the headline
+    gate because the sidecar has its own baseline artifact and one
+    extra, non-numeric check: the layout decision itself."""
+    try:
+        fresh = load_bench(fresh_path)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf-regress[wide]: cannot read fresh sidecar: {exc}")
+        return 2
+    if base_path is None:
+        default = os.path.join(REPO, "BENCH_WIDE.json")
+        if os.path.abspath(fresh_path) != os.path.abspath(default) \
+                and os.path.exists(default):
+            base_path = default
+    if base_path is None:
+        print("perf-regress[wide]: no wide baseline — nothing to gate "
+              "against (pass)")
+        return 0
+    try:
+        base = load_bench(base_path)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf-regress[wide]: cannot read baseline: {exc}")
+        return 2
+    regressions, lines = compare(fresh, base, tol)
+    print(f"perf-regress[wide]: {fresh_path} vs "
+          f"{os.path.basename(base_path)} (tol {tol:.0%})")
+    print("\n".join(lines))
+    # layout flip: the dispatcher silently falling back to planar on
+    # the wide-sparse shape is a regression even at equal wall time
+    # (it re-inflates with scale — the whole point of the sidecar)
+    bl, fl = base.get("hist_layout"), fresh.get("hist_layout")
+    if bl == "multival" and fl != "multival":
+        print(f"  hist_layout          {bl!r} -> {fl!r}  REGRESSION")
+        regressions.append(("hist_layout", bl, fl, float("inf")))
+    elif bl or fl:
+        print(f"  hist_layout          {bl!r} -> {fl!r}  ok")
+    if regressions:
+        print(f"perf-regress[wide]: FAIL — {len(regressions)} key(s) "
+              "regressed")
+        return 1
+    print("perf-regress[wide]: OK")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("fresh", help="fresh bench summary JSON")
@@ -112,6 +167,10 @@ def main(argv=None) -> int:
                         help="baseline file (default: latest BENCH_r*.json)")
     parser.add_argument("--tol", type=float, default=0.10,
                         help="allowed fractional slowdown (default 0.10)")
+    parser.add_argument("--wide-fresh", default=None,
+                        help="fresh BENCH_WIDE.json sidecar to gate")
+    parser.add_argument("--wide-baseline", default=None,
+                        help="wide baseline (default: repo BENCH_WIDE.json)")
     ns = parser.parse_args(argv)
 
     try:
@@ -134,14 +193,18 @@ def main(argv=None) -> int:
     print(f"perf-regress: {ns.fresh} vs {os.path.basename(base_path)} "
           f"(tol {ns.tol:.0%})")
     print("\n".join(lines))
+    rc = 0
     if regressions:
         worst = max(regressions, key=lambda r: r[3])
         print(f"perf-regress: FAIL — {len(regressions)} key(s) "
               f"regressed; worst: {worst[0]} "
               f"{worst[1]:.4g} -> {worst[2]:.4g}")
-        return 1
-    print("perf-regress: OK")
-    return 0
+        rc = 1
+    else:
+        print("perf-regress: OK")
+    if ns.wide_fresh:
+        rc = max(rc, gate_wide(ns.wide_fresh, ns.wide_baseline, ns.tol))
+    return rc
 
 
 if __name__ == "__main__":

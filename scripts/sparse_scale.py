@@ -10,11 +10,19 @@ The pipeline that makes it fit:
   => ~250 B/row of codes instead of 4000.
 
 Run on the TPU chip:  python scripts/sparse_scale.py
+                          [--layout {auto,planar,multival}]
 Env: SPARSE_ROWS (default 13_200_000), SPARSE_VARS (default 500; 8
-one-hot categories each -> 4000 columns), SPARSE_ITERS (default 10).
+one-hot categories each -> 4000 columns), SPARSE_ITERS (default 10),
+SPARSE_LAYOUT (same values as --layout, which wins when both given).
+
+--layout pins tpu_hist_layout for A/B runs of the histogram layout on
+the same shape: "planar" forces the column bin-plane kernels,
+"multival" the row-wise packed-code kernels (ops/multival.py), "auto"
+(default) lets the occupancy dispatcher decide.
 
 Writes docs/SPARSE_SCALE.md with the measured footprint + AUC sanity.
 """
+import argparse
 import os
 import sys
 import time
@@ -27,6 +35,7 @@ ROWS = int(os.environ.get("SPARSE_ROWS", 13_200_000))
 VARS = int(os.environ.get("SPARSE_VARS", 500))
 CATS = 8
 ITERS = int(os.environ.get("SPARSE_ITERS", 10))
+LAYOUT = os.environ.get("SPARSE_LAYOUT", "auto")
 
 
 def make_sparse(n, nvars, ncats, seed=0):
@@ -67,6 +76,11 @@ def make_sparse(n, nvars, ncats, seed=0):
 
 def main():
     import jax
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--layout", default=LAYOUT,
+                        choices=("auto", "planar", "multival"),
+                        help="pin tpu_hist_layout (default: %(default)s)")
+    ns = parser.parse_args()
     T0 = time.time()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
@@ -84,7 +98,8 @@ def main():
           f"(density {X.nnz / (ROWS * VARS * CATS):.3%}) in {t_gen:.0f}s")
 
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
-              "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 20}
+              "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 20,
+              "tpu_hist_layout": ns.layout}
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     ds.construct()
@@ -108,6 +123,10 @@ def main():
     fused = bst._gbdt._fused
     layout = fused.layout if fused is not None else None
     code_bits = layout.code_bits if layout else None
+    from lightgbm_tpu.ops import histogram as H
+    hist_layout = H.hist_layout(bst._gbdt.config, inner)
+    occ = getattr(inner, "occupancy", None)
+    row_nnz = float(occ.row_nnz_mean) if occ is not None else None
 
     # quality sanity vs a dense-subsample model
     sub = np.random.RandomState(1).choice(ROWS, 200_000, replace=False)
@@ -151,6 +170,10 @@ def main():
         f"max_bin=255, {ITERS} measured iterations on one TPU v5e chip.",
         "",
         f"- EFB bundled {VARS * CATS} columns into **{g} bundle columns**",
+        f"- histogram layout: **{hist_layout}** (requested "
+        f"`--layout {ns.layout}`"
+        + (f"; measured mean present codes/row {row_nnz:.2f}"
+           if row_nnz is not None else "") + ")",
         f"- planar code packing: **{code_bits}-bit** "
         "(group bins <= 16 -> dense_bin.hpp IS_4BIT analogue)",
         f"- dataset construct (binning + EFB + packing): {t_construct:.0f}s",

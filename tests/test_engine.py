@@ -474,6 +474,9 @@ class TestSklearn:
         np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-6)
         assert clf.feature_importances_.sum() > 0
 
+    # the sklearn surface is covered by test_classifier/test_regressor
+    # and multiclass by TestObjectives; the combination is full-run only
+    @pytest.mark.slow
     def test_classifier_multiclass(self):
         rng = np.random.RandomState(2)
         X = rng.randn(1200, 5)

@@ -71,6 +71,10 @@ def test_fused_tree_matches_host_loop():
                                rtol=1e-4, atol=1e-6)
 
 
+# fused-vs-host categorical parity stays tier-1 via
+# test_fused_tree_matches_host_loop; the quality/roundtrip extra is
+# full-run only
+@pytest.mark.slow
 def test_train_categorical_quality_and_roundtrip():
     X, y = make_cat_data(seed=3)
     bst = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": -1,

@@ -119,6 +119,9 @@ def test_renew_with_bagging_falls_back_named():
     assert reason is not None and "renew" in reason
 
 
+# data-parallel sharding parity stays tier-1 via test_fused_parallel;
+# the renew x DP combination is full-run only
+@pytest.mark.slow
 def test_renew_sharded_data_parallel_matches_serial():
     """regression_l1 under the 8-device fused data-parallel learner:
     the refit's bisection counts psum across shards, with shard-locally

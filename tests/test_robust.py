@@ -252,6 +252,9 @@ class TestResumeBitIdentity:
     def test_quantized_grad(self, tmp_path):
         _assert_resume_matches_fresh(tmp_path, {"use_quantized_grad": 1})
 
+    # dart resume rides the full run; serial/quantized resume and the
+    # SIGKILL chaos drill keep bit-identity tier-1
+    @pytest.mark.slow
     def test_dart(self, tmp_path):
         _assert_resume_matches_fresh(
             tmp_path, {"boosting": "dart", "drop_rate": 0.5})
@@ -262,6 +265,10 @@ class TestResumeBitIdentity:
             tmp_path, {"bagging_fraction": 0.7, "bagging_freq": 1,
                        "feature_fraction": 0.6, "seed": 9})
 
+    # resume bit-identity stays tier-1 via the serial/quantized variants
+    # and the SIGKILL chaos drill; the early-stopping twin is the
+    # slowest and rides the full run only
+    @pytest.mark.slow
     def test_early_stopping_resume(self, tmp_path):
         X, y = _make_data(600)
         Xv, yv = _make_data(200, seed=8)

@@ -382,6 +382,9 @@ def test_hot_loop_inventory_nonempty():
     assert all(s.annotated for s in sync_points.hot_sites(pkg))
 
 
+# same package scan as test_package_is_clean_against_baseline through a
+# subprocess; the exit-code plumbing is full-run only
+@pytest.mark.slow
 def test_cli_exits_zero_on_clean_repo():
     proc = subprocess.run(
         [sys.executable, "-m", "lightgbm_tpu.analysis", "--format", "json"],
