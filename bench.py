@@ -91,7 +91,7 @@ STATE = {"compile_s": None, "train_s": None, "train_iters": 0,
          "example_auc_reference": None, "hist_method": None,
          "hot_loop_syncs": None, "overlap_share": None,
          "blocking_syncs_per_iter": None, "hist_layout": None,
-         "row_nnz_mean": None}
+         "row_nnz_mean": None, "obs_overhead_pct": None}
 # obs.MetricsRegistry activated in main() once lightgbm_tpu is imported;
 # emit() appends its per-phase breakdown AFTER the pre-existing keys so
 # the line stays byte-compatible on everything consumers already parse
@@ -218,6 +218,22 @@ def emit(partial: bool) -> None:
         out["hist_layout"] = STATE["hist_layout"]
     if STATE["row_nnz_mean"] is not None:
         out["row_nnz_mean"] = round(STATE["row_nnz_mean"], 4)
+    # pod-scale observability plane (schema minor 11), appended after
+    # every pre-existing key so the established prefix stays byte-
+    # identical: iteration tail latency, the device-fetch p99 from the
+    # registry's latency histograms, and the measured A/B overhead of
+    # running the full obs plane (gated at <= 2% by check_perf_regress)
+    if it:
+        out["iter_p99_s"] = round(float(np.percentile(it, 99)), 4)
+    if REGISTRY is not None:
+        fp99 = REGISTRY.latency_percentile("lat.fetch.device_get", 0.99)
+        if fp99 is None:
+            fp99 = REGISTRY.latency_percentile("lat.fetch.block_until_ready",
+                                               0.99)
+        if fp99 is not None:
+            out["fetch_p99_ms"] = round(fp99, 3)
+    if STATE["obs_overhead_pct"] is not None:
+        out["obs_overhead_pct"] = round(STATE["obs_overhead_pct"], 3)
     print(json.dumps(out), flush=True)
     print(f"# rows={ROWS} iters={STATE['iters_done']}/{ITERS} "
           f"leaves={LEAVES} bin={MAX_BIN} compile={compile_s:.1f}s "
@@ -411,6 +427,79 @@ def run_wide_sidecar(lgb):
           file=sys.stderr)
 
 
+def measure_obs_overhead(lgb):
+    """A/B probe for the pod-scale obs plane (schema minor 11): steady-
+    state iteration wall on a small warm-compiled job with the plane OFF
+    (no registry, no sync-call patch) vs fully ON (registry + latency
+    histograms + sync tracing + fleet aggregation + SLO tracking +
+    /metrics endpoint). Returns max(0, (on-off)/off*100); the regression
+    gate holds it at <= 2%. The B window runs first so both windows see
+    the same already-warm executables (A's trees compile nothing new)."""
+    import jax
+    from lightgbm_tpu.obs.flight import FlightRecorder
+    from lightgbm_tpu.obs.httpd import ObsServer
+    rng = np.random.default_rng(11)
+    Xs = rng.standard_normal((20_000, 28)).astype(np.float32)
+    ys = (Xs[:, 0] + 0.5 * Xs[:, 1] + 0.1 * rng.standard_normal(len(Xs))
+          > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+              "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 20}
+    warm, meas = 4, 12
+    # the benchmark's own registry must not absorb either window's spans
+    # (window A must be a true plane-off run, and A/B pollution would
+    # skew the emit() phase breakdown)
+    lgb.obs.deactivate(REGISTRY)
+
+    def window(obs_on):
+        ds = lgb.Dataset(Xs, label=ys)
+        bst = lgb.train(dict(params), ds, num_boost_round=1,
+                        verbose_eval=False, keep_training_booster=True)
+        reg = agg = fr = server = None
+        if obs_on:
+            reg = lgb.obs.MetricsRegistry()
+            lgb.obs.activate(reg)
+            lgb.obs.install_sync_tracing()
+            agg = lgb.obs.FleetAggregator()
+            fr = FlightRecorder("", slo_factor=4.0)
+            server = ObsServer(0, registry=reg)
+            try:
+                server.start()
+            except OSError:
+                server = None
+        try:
+            for _ in range(warm):
+                bst.update()
+            jax.block_until_ready(bst._gbdt.device_score_state())
+            t0 = time.time()
+            for k in range(meas):
+                if obs_on:
+                    reg.begin_iteration(warm + k)
+                it0 = time.time()
+                bst.update()
+                if obs_on:
+                    dt = time.time() - it0
+                    reg.observe("iter_s", dt)
+                    reg.end_iteration()
+                    agg.step(reg, dt)
+                    fr.observe_iteration(warm + k, dt)
+            jax.block_until_ready(bst._gbdt.device_score_state())
+            return (time.time() - t0) / meas
+        finally:
+            if obs_on:
+                lgb.obs.uninstall_sync_tracing()
+                lgb.obs.deactivate(reg)
+                if server is not None:
+                    server.stop()
+            bst.free_dataset()
+
+    try:
+        t_on = window(True)
+        t_off = window(False)
+    finally:
+        lgb.obs.activate(REGISTRY)
+    return max(0.0, (t_on - t_off) / t_off * 100.0) if t_off > 0 else 0.0
+
+
 def main():
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGALRM, _on_signal)
@@ -588,6 +677,16 @@ def main():
             STATE["example_auc"] = run_reference_example(lgb)
         except Exception as exc:
             print(f"# example run failed: {exc}", file=sys.stderr)
+
+    # obs-plane overhead A/B (schema minor 11, gated <= 2%)
+    if os.environ.get("BENCH_OBS_AB", "1") != "0" \
+            and time.time() - T0 < BUDGET * 0.9:
+        try:
+            STATE["obs_overhead_pct"] = measure_obs_overhead(lgb)
+            print(f"# obs overhead A/B: {STATE['obs_overhead_pct']:.2f}%",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"# obs overhead probe failed: {exc}", file=sys.stderr)
 
     emit(partial=STATE["iters_done"] < ITERS)
 

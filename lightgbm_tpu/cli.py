@@ -33,6 +33,9 @@ _FLAG_PARAMS = {
     # preemption-safe training (docs/ROBUSTNESS.md)
     "--checkpoint-dir": "checkpoint_dir",
     "--checkpoint-interval": "checkpoint_interval",
+    # pod-scale observability plane (docs/OBSERVABILITY.md)
+    "--obs-port": "obs_port",
+    "--flight-dir": "flight_dir",
 }
 
 # bare subcommand words accepted as the first argument:

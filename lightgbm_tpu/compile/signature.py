@@ -62,6 +62,10 @@ _IGNORED_CONFIG_FIELDS = frozenset({
     # must hit the same executables (zero new compiles on a warm store)
     "hang_timeout", "auto_resume", "auto_resume_attempts",
     "numeric_sentinels", "sentinel_overflow_limit", "sentinel_max_trips",
+    # pod-scale observability plane: the endpoint, fleet aggregation
+    # and flight recorder are host-side — turning them on must warm
+    # zero new compiles
+    "obs_port", "flight_dir", "flight_slo_factor", "fleet_metrics",
 })
 
 

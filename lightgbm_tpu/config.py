@@ -126,6 +126,11 @@ _ALIASES: Dict[str, str] = {
     "trace_out": "trace_file",
     "trace_output_file": "trace_file",
     "time_tag": "timetag",
+    "obs_http_port": "obs_port",
+    "status_port": "obs_port",
+    "flight_recorder_dir": "flight_dir",
+    "flight_out": "flight_dir",
+    "fleet_telemetry": "fleet_metrics",
     # fault tolerance
     "checkpoint_path": "checkpoint_dir",
     "ckpt_dir": "checkpoint_dir",
@@ -424,6 +429,23 @@ class Config:
     # force background AOT warmup in train() regardless of dataset size
     # (docs/COMPILE_CACHE.md); LGBM_TPU_WARMUP overrides both ways
     tpu_warmup: bool = False
+    # live observability endpoint (/metrics /healthz /statusz) on a
+    # localhost daemon thread; 0 = off (no socket, zero overhead).
+    # Binds 127.0.0.1 — widen with LGBM_TPU_OBS_BIND, an explicit
+    # operator decision (docs/OBSERVABILITY.md "Fleet plane").
+    obs_port: int = 0
+    # flight recorder: on a watchdog / sentinel / SLO trigger, dump an
+    # atomic evidence bundle (trace ring, registry, fleet table, thread
+    # stacks) into this directory. Empty = off.
+    flight_dir: str = ""
+    # SLO trigger threshold: an iteration wall time above
+    # flight_slo_factor x the rolling p50 fires the recorder (needs
+    # flight_dir); <= 1 disables the SLO trigger
+    flight_slo_factor: float = 4.0
+    # fleet aggregation: merge per-rank registry deltas over the
+    # straggler allgather at iteration boundaries (telemetry mode only;
+    # single-process runs never touch the interconnect)
+    fleet_metrics: bool = True
 
     # --- fault tolerance (docs/ROBUSTNESS.md) ---
     # directory for periodic atomic training checkpoints; train()
@@ -708,6 +730,8 @@ class Config:
         self.sentinel_max_trips = max(self.sentinel_max_trips, 1)
         if self.sentinel_overflow_limit <= 0:
             self.sentinel_overflow_limit = 1e30
+        self.obs_port = max(int(self.obs_port), 0)
+        self.flight_slo_factor = max(float(self.flight_slo_factor), 0.0)
         log.set_verbosity(self.verbosity)
 
     def to_params_string(self) -> str:
@@ -721,7 +745,11 @@ class Config:
         skip = ("extra", "checkpoint_dir", "checkpoint_interval",
                 "checkpoint_keep", "hang_timeout", "auto_resume",
                 "auto_resume_attempts", "numeric_sentinels",
-                "sentinel_overflow_limit", "sentinel_max_trips")
+                "sentinel_overflow_limit", "sentinel_max_trips",
+                # the observability plane is operational state too:
+                # where metrics flow must not change the model text
+                "obs_port", "flight_dir", "flight_slo_factor",
+                "fleet_metrics")
         for f in dataclasses.fields(self):
             if f.name in skip:
                 continue

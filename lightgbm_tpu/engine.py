@@ -67,6 +67,13 @@ def _telemetry_end_iteration(telemetry, booster, iteration: int,
     from . import obs
     gbdt = booster._gbdt
     extra: Dict[str, Any] = {}
+    if not telemetry.record_consumers_active():
+        # every record consumer is gone (the sink died on an I/O error,
+        # nothing else is on): don't pay the stream sync + device stat
+        # fetches just to format a payload that gets dropped — the
+        # registry still keeps its lifecycle and counts the drop
+        telemetry.end_iteration(iteration)
+        return
     try:
         with obs.span("telemetry stream sync", phase="sync"):
             # tpulint: sync-ok(telemetry-only stream sync for honest wall time)
@@ -287,6 +294,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     telemetry = obs.TelemetrySession.from_config(booster._gbdt.config)
     if telemetry is not None:
         telemetry.start()
+        telemetry.registry.set_gauge("train.total_iterations",
+                                     float(num_boost_round))
     # dispatch-ahead pipelining (default; LGBM_TPU_PIPELINE=0 restores
     # the fully synchronous loop): iteration t's eval-scalar readback
     # and after-iteration callbacks run only after iteration t+1's
@@ -295,13 +304,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # step late — it can never stop EARLIER than the synchronous loop,
     # trains at most one extra tree, and records the same
     # best_iteration (which the saved model is truncated to, so saved
-    # output is identical). Telemetry mode stays synchronous: its
+    # output is identical). Full telemetry mode stays synchronous: its
     # per-iteration stream sync serializes the loop anyway, and every
-    # JSONL record must carry its own iteration's metrics.
+    # JSONL record must carry its own iteration's metrics. LIGHTWEIGHT
+    # sessions (obs_port / flight_dir only, no metrics_file) ride the
+    # pipelined loop: their per-iteration bookkeeping is host-side
+    # registry arithmetic plus at most the one fleet allgather, never a
+    # stream sync or a device stat fetch.
     # feval also forces the synchronous loop: a custom eval reads the
     # LIVE score arrays at call time, so a deferred call would see the
     # next iteration's scores
-    pipeline = (telemetry is None and feval is None
+    full_telemetry = telemetry is not None and not telemetry.lightweight
+    pipeline = (not full_telemetry and feval is None
                 and os.environ.get("LGBM_TPU_PIPELINE", "1") != "0")
     evaluation_result_list: Optional[list] = None
     pending = None    # (iteration, unresolved eval handle)
@@ -400,14 +414,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         booster._gbdt.begin_eval_at_iter()
                         if valid_contain_train or booster.name_valid_sets
                         else None)
-                if telemetry is not None:
+                if full_telemetry:
                     evaluation_result_list = _resolve_evals(eval_handle)
                     eval_handle = None
                     _telemetry_end_iteration(telemetry, booster, i,
                                              evaluation_result_list)
+                elif telemetry is not None:
+                    # lightweight: registry wall-clock + fleet merge +
+                    # SLO check only — no stream sync, no device fetch;
+                    # the window ends at dispatch, trailing resolve time
+                    # is attributed to the next iteration
+                    telemetry.end_iteration(i)
                 drained_it = i
                 try:
-                    if telemetry is not None:
+                    if full_telemetry:
                         _after_callbacks(i, evaluation_result_list)
                     else:
                         # trailing resolve: the PREVIOUS iteration's eval
@@ -515,18 +535,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 "watchdog: auto-resuming from iteration %d after a "
                 "detected hang (attempt %d/%d)", start_iteration,
                 resume_attempts, cfg.auto_resume_attempts)
+      # resolve any sentinel verdicts still in flight so a trip on the
+      # final trees still quarantines them before the model is
+      # finalized — before the finally below deactivates the flight
+      # recorder, so a tail-end trip still dumps its evidence bundle
+      if getattr(booster._gbdt, "_sentinel", None) is not None:
+          booster._gbdt.sentinel_drain()
+          booster._gbdt.process_sentinel_trips()
     finally:
         if wd is not None:
             deactivate_watchdog(wd)
             wd.stop()
         if telemetry is not None:
             telemetry.close()
-
-    # resolve any sentinel verdicts still in flight so a trip on the
-    # final trees still quarantines them before the model is finalized
-    if getattr(booster._gbdt, "_sentinel", None) is not None:
-        booster._gbdt.sentinel_drain()
-        booster._gbdt.process_sentinel_trips()
 
     # fused path trains blind between periodic stop checks; drop any
     # trailing all-degenerate iterations it may have accumulated

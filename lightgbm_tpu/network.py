@@ -109,14 +109,10 @@ def straggler_stats(seconds: float) -> Tuple[float, int]:
     sampled on the telemetry path and read back from the
     ``coll.slowest_rank`` gauge)."""
     try:
-        import jax
-        if jax.process_count() <= 1:
+        gathered = fleet_allgather([seconds])
+        if gathered is None:
             return 0.0, 0
-        import numpy as np
-        from jax.experimental import multihost_utils
-        times = np.asarray(
-            multihost_utils.process_allgather(np.float32(seconds)),
-            dtype=np.float64).ravel()
+        times = gathered[:, 0]
         mean = float(times.mean())
         if mean <= 0.0:
             return 0.0, 0
@@ -124,6 +120,30 @@ def straggler_stats(seconds: float) -> Tuple[float, int]:
                 int(times.argmax()))
     except Exception:
         return 0.0, 0
+
+
+def fleet_allgather(payload, _gather=None):
+    """One `process_allgather` of a small per-rank float32 vector — THE
+    single blocking host sync per iteration the telemetry plane is
+    allowed (docs/OBSERVABILITY.md "Fleet plane"). The fleet aggregator
+    (obs/aggregate.py) widens the payload that `straggler_stats` used to
+    gather alone, so pod-level metrics piggyback on the already-paid
+    skew barrier instead of adding a second one.
+
+    Returns an (nranks, len(payload)) float64 array, or None on
+    single-process runs (no interconnect touched). `_gather` is
+    injectable for tests: it receives the local float32 vector and must
+    return the stacked per-rank payloads."""
+    import numpy as np
+    vec = np.asarray(payload, dtype=np.float32).ravel()
+    if _gather is None:
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        from jax.experimental import multihost_utils
+        _gather = multihost_utils.process_allgather
+    out = np.asarray(_gather(vec), dtype=np.float64)
+    return out.reshape(-1, vec.size)
 
 
 def parse_machine_list(machines: str) -> List[str]:

@@ -13,6 +13,10 @@ Semantics:
 - gauges are last-write-wins point samples,
 - histograms accumulate per ITERATION (reset at `begin_iteration`) and
   snapshot as {count, sum, min, max},
+- latency histograms (`observe_latency`, schema minor 11) are
+  CUMULATIVE fixed-bucket log-scale distributions with derived
+  p50/p90/p99 gauges — the Prometheus-exposable shape the serving
+  path will gate on,
 - phase times (`add_time`) are cumulative like counters; the snapshot
   reports the per-iteration DELTA of the three core tree phases
   (hist / split / partition) plus the residual `t_other_s`, so the four
@@ -24,9 +28,10 @@ so a disabled run pays one `is None` check per instrumented call.
 """
 from __future__ import annotations
 
+import bisect
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 # retained latency samples per collective op for the p99 estimate; a
 # bounded deque keeps the registry O(1)-memory over arbitrarily long
@@ -36,6 +41,93 @@ _COLL_LAT_SAMPLES = 4096
 # phases with first-class snapshot fields; everything else shows up in
 # the snapshot's "phases" map only
 CORE_PHASES = ("hist", "split", "partition")
+
+# shared log-scale bucket upper bounds (milliseconds) for every latency
+# histogram: 8 buckets per decade from 1 µs to 100 s, ratio 10^(1/8)
+# ≈ 1.33 — relative quantile error is bounded by half a bucket ratio
+# (~15%), constant memory, and every histogram is mergeable across
+# ranks/processes because the edges are fixed at import time
+LATENCY_BUCKET_EDGES_MS: Tuple[float, ...] = tuple(
+    10.0 ** (e / 8.0) for e in range(-24, 41))
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-scale latency distribution (milliseconds).
+
+    Cumulative over the registry lifetime (Prometheus-histogram
+    semantics: monotone bucket counts). Bucket i counts observations
+    `v <= LATENCY_BUCKET_EDGES_MS[i]`; one extra overflow bucket
+    (`+Inf`) catches the tail. Percentiles interpolate linearly inside
+    the owning bucket and clamp to the observed min/max, so small
+    sample sets stay honest at the extremes.
+    """
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(LATENCY_BUCKET_EDGES_MS) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, ms: float) -> None:
+        ms = float(ms)
+        self.counts[bisect.bisect_left(LATENCY_BUCKET_EDGES_MS, ms)] += 1
+        self.count += 1
+        self.sum += ms
+        if ms < self.min:
+            self.min = ms
+        if ms > self.max:
+            self.max = ms
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 1]; None when empty."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i == 0:
+                    lo = 0.0
+                elif i >= len(LATENCY_BUCKET_EDGES_MS):
+                    lo = LATENCY_BUCKET_EDGES_MS[-1]
+                else:
+                    lo = LATENCY_BUCKET_EDGES_MS[i - 1]
+                hi = (LATENCY_BUCKET_EDGES_MS[i]
+                      if i < len(LATENCY_BUCKET_EDGES_MS) else self.max)
+                frac = (rank - cum) / c
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return min(self.max, max(self.min, est))
+            cum += c
+        return self.max
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSONL shape (schema minor 11): summary stats, the three
+        derived percentiles, and the NONZERO buckets as [le_ms, count]
+        pairs (cumulative counts would serialize 66 entries per
+        histogram per iteration; sparse non-cumulative is equivalent
+        information at a fraction of the bytes)."""
+        buckets = []
+        for i, c in enumerate(self.counts):
+            if c:
+                le = (LATENCY_BUCKET_EDGES_MS[i]
+                      if i < len(LATENCY_BUCKET_EDGES_MS) else float("inf"))
+                buckets.append([round(le, 6) if le != float("inf") else "inf",
+                                c])
+        return {
+            "count": self.count,
+            "sum_ms": round(self.sum, 6),
+            "min_ms": round(self.min, 6),
+            "max_ms": round(self.max, 6),
+            "p50_ms": round(self.percentile(0.50) or 0.0, 6),
+            "p90_ms": round(self.percentile(0.90) or 0.0, 6),
+            "p99_ms": round(self.percentile(0.99) or 0.0, 6),
+            "buckets": buckets,
+        }
 
 
 class MetricsRegistry:
@@ -50,6 +142,8 @@ class MetricsRegistry:
         self._times_at_begin: Dict[str, float] = {}
         # op -> bounded deque of host-latency seconds (schema minor 5)
         self._coll_lat: Dict[str, deque] = {}
+        # name -> cumulative log-scale histogram (schema minor 11)
+        self._lat: Dict[str, LatencyHistogram] = {}
 
     # -- accumulation ---------------------------------------------------
     def inc(self, name: str, value: float = 1) -> None:
@@ -71,6 +165,27 @@ class MetricsRegistry:
     def add_time(self, phase: str, seconds: float) -> None:
         self.times[phase] = self.times.get(phase, 0.0) + seconds
 
+    def observe_latency(self, name: str, ms: float) -> None:
+        """Feed one sample into the cumulative log-scale histogram
+        `name` (conventionally `lat.phase.<phase>` / `lat.coll.<op>` /
+        `lat.fetch.<kind>`). One bisect over 65 fixed edges — cheap
+        enough for every span and every device fetch."""
+        h = self._lat.get(name)
+        if h is None:
+            h = self._lat[name] = LatencyHistogram()
+        h.observe(ms)
+
+    def latency_percentile(self, name: str, q: float) -> Optional[float]:
+        """Percentile (ms) of latency histogram `name`; None when the
+        histogram does not exist or is empty."""
+        h = self._lat.get(name)
+        return h.percentile(q) if h is not None else None
+
+    def latency_histograms(self) -> Dict[str, LatencyHistogram]:
+        """Live view for exporters (Prometheus endpoint, fleet payloads
+        ); treat as read-only."""
+        return self._lat
+
     def record_collective(self, op: str, nbytes: int, seconds: float,
                           axis: str = "") -> None:
         """One collective dispatch: call count, payload bytes (computed
@@ -83,6 +198,7 @@ class MetricsRegistry:
         # per-iteration latency histogram (snapshots into "hists") +
         # bounded cumulative sample set for the session p99
         self.observe(f"coll.{op}.ms", seconds * 1e3)
+        self.observe_latency(f"lat.coll.{op}", seconds * 1e3)
         lat = self._coll_lat.get(op)
         if lat is None:
             lat = self._coll_lat[op] = deque(maxlen=_COLL_LAT_SAMPLES)
@@ -121,6 +237,16 @@ class MetricsRegistry:
         from .sink import SCHEMA_MINOR, SCHEMA_VERSION
         t1 = time.perf_counter() if now is None else now
         t_iter = max(0.0, t1 - self._iter_t0)
+        # derived latency percentiles land as gauges BEFORE the gauge
+        # map is copied into the record, so JSONL, /metrics and the
+        # fleet payload all see the same three numbers per histogram
+        for name, h in self._lat.items():
+            p50 = h.percentile(0.50)
+            if p50 is None:
+                continue
+            self.gauges[f"{name}.p50_ms"] = round(p50, 6)
+            self.gauges[f"{name}.p90_ms"] = round(h.percentile(0.90), 6)
+            self.gauges[f"{name}.p99_ms"] = round(h.percentile(0.99), 6)
         deltas = {ph: self.times.get(ph, 0.0)
                   - self._times_at_begin.get(ph, 0.0)
                   for ph in CORE_PHASES}
@@ -146,6 +272,9 @@ class MetricsRegistry:
                 k: {"count": int(h[0]), "sum": round(h[1], 6),
                     "min": round(h[2], 6), "max": round(h[3], 6)}
                 for k, h in sorted(self._hist.items())}
+        if self._lat:
+            rec["lat"] = {k: self._lat[k].snapshot()
+                          for k in sorted(self._lat)}
         if extra:
             rec.update(extra)
         self.last_record = rec
@@ -170,7 +299,8 @@ class MetricsRegistry:
             if key.startswith(("collective.", "kernel.", "compile.",
                                "eval.", "hist.", "coll.", "trace.",
                                "ckpt.", "fault.", "pipeline.",
-                               "watchdog.", "health.")):
+                               "watchdog.", "health.", "flight.",
+                               "slo.", "sink.")):
                 v = self.counters[key]
                 out[key.replace(".", "_")] = int(v) if v == int(v) else v
         return out
@@ -181,6 +311,7 @@ class MetricsRegistry:
         self.times.clear()
         self._hist.clear()
         self._coll_lat.clear()
+        self._lat.clear()
         self.last_record = None
         self._iteration = None
 

@@ -15,10 +15,19 @@ each iteration window covered by the union of its phase intervals
 (union-of-intervals, so nested/overlapping spans don't double-count).
 
 CLI: `python -m lightgbm_tpu trace-report <trace.json> [--top N]`.
+Pod-scale extras (docs/OBSERVABILITY.md):
+
+- `trace-report --merge r0.json r1.json ... [--out merged.json]` folds
+  per-rank traces into one Perfetto document (rank r => pid r) and then
+  summarizes the merge,
+- `trace-report --flight <dir>` summarizes a flight-recorder bundle
+  (or picks the newest bundle inside a flight_dir): trigger, registry
+  headline counters, and the embedded trace's report.
 """
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
@@ -151,6 +160,68 @@ def format_report(summary: Dict[str, Any], path: str = "") -> str:
     return "\n".join(lines)
 
 
+def find_bundle(path: str) -> str:
+    """Resolve a flight bundle directory: either ``path`` itself (it
+    holds a manifest.json) or the newest ``flight_*`` bundle inside a
+    flight_dir."""
+    if os.path.isfile(os.path.join(path, "manifest.json")):
+        return path
+    bundles = sorted(
+        d for d in (os.path.join(path, n) for n in os.listdir(path))
+        if os.path.basename(d).startswith("flight_")
+        and os.path.isfile(os.path.join(d, "manifest.json")))
+    if not bundles:
+        raise ValueError(f"{path}: no flight bundle (manifest.json) found")
+    return bundles[-1]
+
+
+def format_flight_report(bundle: str, top_n: int = 10) -> str:
+    """Human summary of one flight-recorder bundle (obs/flight.py)."""
+    def _load(name: str) -> Any:
+        p = os.path.join(bundle, name)
+        if not os.path.isfile(p):
+            return None
+        with open(p) as fh:
+            return json.load(fh)
+
+    manifest = _load("manifest.json") or {}
+    registry = _load("registry.json") or {}
+    fleet = _load("fleet.json")
+    lines = [f"flight bundle: {bundle}",
+             f"trigger: {manifest.get('trigger', '?')}"]
+    info = manifest.get("info") or {}
+    if info:
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(info.items())
+                           if not isinstance(v, (dict, list)))
+        if detail:
+            lines.append(f"info: {detail}")
+    counters = registry.get("counters") or {}
+    head = [k for k in ("watchdog.trips", "health.sentinel_trips",
+                        "slo.breaches", "flight.dumps", "sink.dropped_payloads")
+            if k in counters]
+    if head:
+        lines.append("counters: " + "  ".join(
+            f"{k}={counters[k]:g}" for k in head))
+    last = registry.get("last_record") or {}
+    if last.get("iteration") is not None:
+        lines.append(f"last iteration: {last['iteration']}  "
+                     f"t_iter_s: {last.get('t_iter_s', float('nan')):.4g}")
+    if isinstance(fleet, dict) and fleet.get("ranks"):
+        lines.append(
+            f"fleet: {fleet['ranks']} rank(s)  skew {fleet['skew']:.3g}  "
+            f"slowest rank {fleet['slowest_rank']}")
+    trace_path = os.path.join(bundle, "trace.json")
+    if os.path.isfile(trace_path):
+        try:
+            events = load_trace(trace_path)
+            lines.append("")
+            lines.append(format_report(summarize(events, top_n=top_n),
+                                       path=trace_path))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            lines.append(f"trace.json unreadable: {exc}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -158,14 +229,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="python -m lightgbm_tpu trace-report",
         description="Summarize a runtime trace.json "
                     "(train with trace_file=... to produce one).")
-    parser.add_argument("trace", help="path to trace.json")
+    parser.add_argument("trace", nargs="*",
+                        help="path to trace.json (several with --merge)")
     parser.add_argument("--top", type=int, default=10,
                         help="rows per table (default 10)")
+    parser.add_argument("--merge", action="store_true",
+                        help="merge per-rank traces (rank r => pid r), "
+                             "write --out, then summarize the merge")
+    parser.add_argument("--out", default="merged_trace.json",
+                        help="merged trace output path (default "
+                             "merged_trace.json)")
+    parser.add_argument("--flight", metavar="DIR",
+                        help="summarize a flight-recorder bundle (or the "
+                             "newest bundle inside a flight_dir)")
     ns = parser.parse_args(argv)
+    if ns.flight:
+        try:
+            print(format_flight_report(find_bundle(ns.flight), top_n=ns.top))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}")
+            return 2
+        return 0
+    if ns.merge:
+        if len(ns.trace) < 2:
+            parser.error("--merge needs two or more per-rank traces")
+        from .trace import merge_trace_files
+        try:
+            doc = merge_trace_files(ns.trace, ns.out)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}")
+            return 2
+        print(f"merged {len(ns.trace)} rank traces -> {ns.out}")
+        print(format_report(summarize(doc["traceEvents"], top_n=ns.top),
+                            path=ns.out))
+        return 0
+    if len(ns.trace) != 1:
+        parser.error("expected exactly one trace.json "
+                     "(or --merge / --flight)")
     try:
-        events = load_trace(ns.trace)
+        events = load_trace(ns.trace[0])
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"error: {exc}")
         return 2
-    print(format_report(summarize(events, top_n=ns.top), path=ns.trace))
+    print(format_report(summarize(events, top_n=ns.top), path=ns.trace[0]))
     return 0

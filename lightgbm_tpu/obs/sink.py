@@ -38,6 +38,12 @@ Iteration record (v1.2):
             mem_peak_bytes / coll_p99_ms bench summary fields),
             phases (object: cumulative seconds per phase),
             hists (object: {count, sum, min, max}),
+            lat (object, minor 11: cumulative log-scale latency
+            histograms — {count, sum_ms, min_ms, max_ms, p50_ms,
+            p90_ms, p99_ms, buckets: [[le_ms | "inf", count], ...]}),
+            fleet (object, minor 11: pod-level view merged by
+            obs/aggregate.py — ranks, iter_min/mean/max_s, skew,
+            skew_trend, slowest_rank, per_rank straggler table),
             metrics (object: "<dataset>/<metric>" -> number),
             num_leaves (int), best_gain (number)
 
@@ -79,8 +85,14 @@ SCHEMA_VERSION = 1
 # joined (hist.multival_rows packed-row counter and the
 # hist.layout_planar / hist.layout_multival dispatch counters under
 # `counters`, the hist.row_nnz_mean occupancy gauge, plus the
-# row_nnz_mean / hist_layout bench summary fields)
-SCHEMA_MINOR = 10
+# row_nnz_mean / hist_layout bench summary fields), to 11 when the
+# pod-scale observability plane joined (the `lat` latency-histogram
+# object with derived "lat.*.p{50,90,99}_ms" gauges, the `fleet`
+# per-rank object, the flight.dumps / flight.<trigger> /
+# flight.failed / slo.breaches / sink.dropped_payloads counters, plus
+# the iter_p99_s / fetch_p99_ms / obs_overhead_pct bench summary
+# fields)
+SCHEMA_MINOR = 11
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -105,7 +117,9 @@ _BENCH_OPTIONAL_NUM = ("vs_baseline_with_compile", "compile_s", "rows",
                        "compile_programs", "compile_lowering_s",
                        "compile_hlo_bytes",
                        # multival layout occupancy (schema minor 10)
-                       "row_nnz_mean")
+                       "row_nnz_mean",
+                       # pod-scale observability plane (schema minor 11)
+                       "iter_p99_s", "fetch_p99_ms", "obs_overhead_pct")
 # optional string-typed bench keys (minor 2): histogram kernel variant;
 # (minor 5): runtime trace output path; (minor 10): histogram layout
 # decision ("planar" | "multival")
@@ -171,6 +185,45 @@ def validate_record(rec: Any) -> List[str]:
                                 for f in ("count", "sum", "min", "max")):
                     problems.append(f"hists[{k!r}] must have numeric "
                                     "count/sum/min/max")
+    if "lat" in rec:
+        if not isinstance(rec["lat"], dict):
+            problems.append("'lat' must be an object")
+        else:
+            for k, h in rec["lat"].items():
+                if not isinstance(h, dict) or \
+                        not all(isinstance(h.get(f), (int, float))
+                                for f in ("count", "sum_ms", "p50_ms",
+                                          "p90_ms", "p99_ms")):
+                    problems.append(f"lat[{k!r}] must have numeric "
+                                    "count/sum_ms/p50_ms/p90_ms/p99_ms")
+                    continue
+                buckets = h.get("buckets", [])
+                if not isinstance(buckets, list) or not all(
+                        isinstance(b, list) and len(b) == 2
+                        and (isinstance(b[0], (int, float)) or b[0] == "inf")
+                        and isinstance(b[1], int)
+                        for b in buckets):
+                    problems.append(f"lat[{k!r}].buckets must be "
+                                    "[le_ms|\"inf\", count] pairs")
+    if "fleet" in rec:
+        fl = rec["fleet"]
+        if not isinstance(fl, dict):
+            problems.append("'fleet' must be an object")
+        else:
+            for f in ("ranks", "iter_min_s", "iter_mean_s", "iter_max_s",
+                      "skew", "skew_trend", "slowest_rank"):
+                if not isinstance(fl.get(f), (int, float)) or \
+                        isinstance(fl.get(f), bool):
+                    problems.append(f"fleet.{f} must be a number")
+            pr = fl.get("per_rank")
+            if not isinstance(pr, list) or not all(
+                    isinstance(row, dict)
+                    and isinstance(row.get("rank"), int)
+                    and isinstance(row.get("iter_s"), (int, float))
+                    and isinstance(row.get("slowest_count"), int)
+                    for row in pr):
+                problems.append("fleet.per_rank must be a list of "
+                                "{rank, iter_s, slowest_count, ...} rows")
     return problems
 
 
@@ -213,15 +266,24 @@ class JsonlSink:
 
     Telemetry must never take down training: any OSError (disk full,
     permissions, injected fault) disables the sink with ONE warning and
-    every later write is a no-op."""
+    every later write is a no-op. Callers that assemble expensive
+    payloads should consult `disabled` FIRST (TelemetrySession does) —
+    a disabled sink still counts the writes it would have taken in
+    `dropped`, so silently lost telemetry shows up as the
+    `sink.dropped_payloads` counter instead of a mystery gap."""
 
     def __init__(self, path: str) -> None:
         self.path = path
+        self.dropped = 0
         try:
             self._fh = open(path, "w")
         except OSError as exc:
             self._fh = None
             self._disable(exc)
+
+    @property
+    def disabled(self) -> bool:
+        return self._fh is None
 
     def _disable(self, exc: BaseException) -> None:
         from ..utils import log
@@ -237,6 +299,7 @@ class JsonlSink:
 
     def write(self, record: Dict[str, Any]) -> None:
         if self._fh is None:
+            self.dropped += 1
             return
         try:
             from ..robust.faultinject import check_fault

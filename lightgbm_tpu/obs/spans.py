@@ -65,6 +65,7 @@ def span(name: str, phase: Optional[str] = None):
                     gt.cnt[name] += 1
                 if reg is not None and phase is not None:
                     reg.add_time(phase, dt)
+                    reg.observe_latency(f"lat.phase.{phase}", dt * 1e3)
             finally:
                 if tr is not None:
                     tr.complete(name, "phase", tr_t0, tr.now_ns(),

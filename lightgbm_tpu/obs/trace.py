@@ -259,31 +259,47 @@ def install_sync_tracing() -> bool:
         return False
 
     real_get, real_block = jax.device_get, jax.block_until_ready
+    from . import registry as _registry
+    reg_active = _registry.active
 
     def traced_device_get(*args, **kwargs):
         tr = _ACTIVE
-        if tr is None:
+        reg = reg_active()
+        if tr is None and reg is None:
             return real_get(*args, **kwargs)
-        t0 = tr.now_ns()
+        t0 = time.perf_counter_ns()
         try:
             return real_get(*args, **kwargs)
         finally:
-            tr.sync("device_get",
-                    package_site(skip_dirs=("analysis", "obs")),
-                    t0, tr.now_ns(),
-                    _payload_bytes(args[0] if args else None))
+            t1 = time.perf_counter_ns()
+            if reg is not None:
+                # fetch-latency histogram (schema minor 11) — fed even
+                # without a tracer, so `obs_port`-only sessions still
+                # expose lat.fetch.* percentiles
+                reg.observe_latency("lat.fetch.device_get", (t1 - t0) / 1e6)
+            if tr is not None:
+                tr.sync("device_get",
+                        package_site(skip_dirs=("analysis", "obs")),
+                        t0 - tr.t0_ns, t1 - tr.t0_ns,
+                        _payload_bytes(args[0] if args else None))
 
     def traced_block_until_ready(*args, **kwargs):
         tr = _ACTIVE
-        if tr is None:
+        reg = reg_active()
+        if tr is None and reg is None:
             return real_block(*args, **kwargs)
-        t0 = tr.now_ns()
+        t0 = time.perf_counter_ns()
         try:
             return real_block(*args, **kwargs)
         finally:
-            tr.sync("block_until_ready",
-                    package_site(skip_dirs=("analysis", "obs")),
-                    t0, tr.now_ns())
+            t1 = time.perf_counter_ns()
+            if reg is not None:
+                reg.observe_latency("lat.fetch.block_until_ready",
+                                    (t1 - t0) / 1e6)
+            if tr is not None:
+                tr.sync("block_until_ready",
+                        package_site(skip_dirs=("analysis", "obs")),
+                        t0 - tr.t0_ns, t1 - tr.t0_ns)
 
     jax.device_get = traced_device_get
     jax.block_until_ready = traced_block_until_ready
@@ -303,6 +319,53 @@ def uninstall_sync_tracing() -> None:
     except Exception:
         pass
     _SYNC_PATCH = None
+
+
+# -- multi-rank trace merge ----------------------------------------------
+def merge_trace_events(per_rank_events: List[List[Dict[str, Any]]]
+                       ) -> Dict[str, Any]:
+    """Merge per-rank trace-event lists into ONE Perfetto timeline with
+    per-rank process tracks: input r becomes pid r (whatever pid the
+    producing host wrote — files exported on different hosts can all
+    carry their own process_index, or all carry 0 when each host thought
+    itself alone), and the per-category track machinery (`_TRACK_NAMES`)
+    is re-emitted per pid so every rank gets its own named phase / sync /
+    collective / iteration rows."""
+    merged: List[Dict[str, Any]] = []
+    for rank, events in enumerate(per_rank_events):
+        merged.append({"ph": "M", "pid": rank, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"lightgbm_tpu rank {rank}"}})
+        merged.append({"ph": "M", "pid": rank, "tid": 0,
+                       "name": "process_sort_index",
+                       "args": {"sort_index": rank}})
+        for tid, tname in _TRACK_NAMES.items():
+            merged.append({"ph": "M", "pid": rank, "tid": tid,
+                           "name": "thread_name", "args": {"name": tname}})
+        for ev in events:
+            if ev.get("ph") == "M":
+                continue            # replaced by the per-rank metadata
+            ev = dict(ev)
+            ev["pid"] = rank
+            merged.append(ev)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "otherData": {"merged_ranks": len(per_rank_events)}}
+
+
+def merge_trace_files(paths: List[str], out_path: str) -> Dict[str, Any]:
+    """`trace-report --merge r0.json r1.json ...`: load each rank's
+    exported trace (traceEvents dict or bare event array), merge, write
+    `out_path`, return the merged document."""
+    per_rank = []
+    for path in paths:
+        with open(path) as fh:
+            doc = json.load(fh)
+        per_rank.append(doc["traceEvents"] if isinstance(doc, dict)
+                        else doc)
+    merged = merge_trace_events(per_rank)
+    with open(out_path, "w") as fh:
+        json.dump(merged, fh)
+    return merged
 
 
 # -- device memory sampling ----------------------------------------------

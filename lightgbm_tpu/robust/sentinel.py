@@ -150,6 +150,18 @@ class NumericSentinel:
         self._trips_out.append((iteration, kind))
         self._count("health.sentinel_trips")
         self._count(f"health.{kind}")
+        try:
+            # flight recorder (docs/OBSERVABILITY.md): capture the state
+            # that produced the bad plane before recovery rewrites it
+            from ..obs.flight import active_flight
+            fr = active_flight()
+            if fr is not None:
+                fr.dump("sentinel", {"iteration": iteration, "kind": kind,
+                                     "nonfinite": nonfinite,
+                                     "overflow": overflow,
+                                     "overflow_limit": self.overflow_limit})
+        except Exception:
+            pass
         log.warning(
             "sentinel: numeric-health trip at iteration %d — %d non-finite"
             " / %d overflowed (>|%g|) values in the new tree",

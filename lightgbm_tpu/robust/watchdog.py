@@ -202,6 +202,15 @@ class Watchdog:
                      "phase": phase, "age_s": age, "iteration": iteration,
                      "host_skew": skew, "slowest_rank": slowest,
                      "trace_file": trace_file}
+        try:
+            # flight recorder (docs/OBSERVABILITY.md): snapshot the trace
+            # ring + registry + fleet table while the hang is still live
+            from ..obs.flight import active_flight
+            fr = active_flight()
+            if fr is not None:
+                fr.dump("watchdog", diagnosis)
+        except Exception:
+            pass
         self.trip_count += 1
         with self._lock:
             self.tripped = diagnosis

@@ -30,7 +30,12 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   bench summary fields; v1.10 adds the multi-value histogram layout
   fields — the `hist.multival_rows` / `hist.layout_planar` /
   `hist.layout_multival` counters, the `hist.row_nnz_mean` gauge, and
-  the `row_nnz_mean` / `hist_layout` bench summary fields),
+  the `row_nnz_mean` / `hist_layout` bench summary fields; v1.11 adds
+  the pod-scale observability plane — the optional per-record `lat`
+  latency-histogram map (fixed log-scale buckets with derived
+  p50/p90/p99 gauges) and `fleet` fleet-merged per-rank block, the
+  `flight.*` / `slo.*` / `sink.*` counters, and the `iter_p99_s` /
+  `fetch_p99_ms` / `obs_overhead_pct` bench summary fields),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

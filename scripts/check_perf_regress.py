@@ -13,6 +13,8 @@ prediction can't regress predict latency):
 
 - value            (train wall-clock seconds, the headline number)
 - iter_p50_s       (steady-state per-iteration latency)
+- iter_p99_s       (iteration tail latency — a straggler or periodic
+  stall widens the tail long before it moves the median)
 - predict_us_per_row
 - hot_loop_syncs   (static hot-loop sync-point inventory size)
 - blocking_syncs_per_iter (runtime blocking host syncs per streamed
@@ -23,6 +25,10 @@ prediction can't regress predict latency):
   compile-window gate: a change that re-introduces a capacity ladder
   or splits a shared signature shows up here even when the compile
   seconds hide it on a fast build machine)
+
+Additionally, obs_overhead_pct (the bench's own A/B probe of the
+pod-scale observability plane) gates against an ABSOLUTE 2% ceiling
+whenever the fresh line carries it — no baseline needed.
 
 Usage:
     python scripts/check_perf_regress.py FRESH.json [--tol 0.10]
@@ -55,9 +61,15 @@ from typing import Any, Dict, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # lower-is-better keys the gate compares
-PERF_KEYS = ("value", "iter_p50_s", "predict_us_per_row",
+PERF_KEYS = ("value", "iter_p50_s", "iter_p99_s", "predict_us_per_row",
              "hot_loop_syncs", "blocking_syncs_per_iter",
              "compile_s", "compile_programs")
+
+# absolute ceiling for the obs-plane A/B probe (schema minor 11): the
+# observability plane may never cost more than 2% of steady-state
+# iteration wall, baseline or not — an absolute gate, since the probe
+# measures its own overhead within one run
+OBS_OVERHEAD_MAX_PCT = 2.0
 
 
 def unwrap(doc: Any) -> Optional[Dict[str, Any]]:
@@ -193,6 +205,16 @@ def main(argv=None) -> int:
     print(f"perf-regress: {ns.fresh} vs {os.path.basename(base_path)} "
           f"(tol {ns.tol:.0%})")
     print("\n".join(lines))
+    ov = fresh.get("obs_overhead_pct")
+    if isinstance(ov, (int, float)) and not isinstance(ov, bool):
+        if ov > OBS_OVERHEAD_MAX_PCT:
+            print(f"  obs_overhead_pct     {ov:.3g}% > "
+                  f"{OBS_OVERHEAD_MAX_PCT:g}% ceiling  REGRESSION")
+            regressions.append(("obs_overhead_pct", OBS_OVERHEAD_MAX_PCT,
+                                ov, ov / OBS_OVERHEAD_MAX_PCT))
+        else:
+            print(f"  obs_overhead_pct     {ov:.3g}% <= "
+                  f"{OBS_OVERHEAD_MAX_PCT:g}% ceiling  ok")
     rc = 0
     if regressions:
         worst = max(regressions, key=lambda r: r[3])

@@ -42,6 +42,55 @@ for f in data["new"]:
 EOF
 fi
 
+# obs endpoint smoke (docs/OBSERVABILITY.md): boot the stdlib /metrics
+# server on an ephemeral loopback port and hit all three endpoints with
+# http.client — in-process, curl-free, no jax import, <1s
+echo "== obs endpoint smoke =="
+if JAX_PLATFORMS=cpu python - <<'EOF'
+import http.client, json
+
+from lightgbm_tpu.obs.httpd import ObsServer
+from lightgbm_tpu.obs.registry import MetricsRegistry, activate, deactivate
+
+reg = MetricsRegistry()
+reg.inc("train.trees", 3)
+reg.set_gauge("mem.live_bytes", 1024.0)
+reg.observe_latency("lat.fetch.device_get", 0.5)
+activate(reg)   # /healthz and /statusz read the process-global active
+srv = ObsServer(0, registry=reg)
+port = srv.start()
+try:
+    def get(path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        conn.close()
+        return resp.status, body
+
+    st, body = get("/metrics")
+    assert st == 200, f"/metrics -> {st}"
+    assert "lgbm_tpu_train_trees 3" in body, body
+    assert 'lgbm_tpu_lat_fetch_device_get_ms_bucket{le="+Inf"} 1' in body, \
+        body
+    st, body = get("/healthz")
+    assert st == 200 and json.loads(body)["status"] == "ok", (st, body)
+    st, body = get("/statusz")
+    assert st == 200 and "latency_ms" in json.loads(body), (st, body)
+    st, _ = get("/nope")
+    assert st == 404, st
+finally:
+    srv.stop()
+    deactivate(reg)
+print("obs endpoints: ok")
+EOF
+then
+    :
+else
+    status=1
+    echo "OBS ENDPOINT SMOKE FAILED"
+fi
+
 # optional perf-regression gate: set PERF_REGRESS_BENCH to a fresh
 # bench.py summary JSON to compare it against the latest BENCH_r*.json
 # (the static lane has no TPU, so this only runs when a bench result is

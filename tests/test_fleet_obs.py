@@ -1,0 +1,497 @@
+"""Pod-scale observability plane (docs/OBSERVABILITY.md "Fleet plane"):
+latency histograms, fleet-merged metrics, the live /metrics//healthz/
+/statusz endpoint, and the anomaly-triggered flight recorder."""
+import http.client
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import registry as obs_registry
+from lightgbm_tpu.obs.aggregate import (FleetAggregator,
+                                        deactivate_aggregator)
+from lightgbm_tpu.obs.flight import FlightRecorder, deactivate_flight
+from lightgbm_tpu.obs.httpd import ObsServer, render_prometheus
+from lightgbm_tpu.obs.registry import (LATENCY_BUCKET_EDGES_MS,
+                                       LatencyHistogram)
+from lightgbm_tpu.robust.faultinject import install_plan
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_actives():
+    """Each test starts and ends with no active registry / aggregator /
+    flight recorder (and no armed fault plan)."""
+    obs_registry.deactivate()
+    deactivate_aggregator()
+    deactivate_flight()
+    install_plan(None)
+    yield
+    obs_registry.deactivate()
+    deactivate_aggregator()
+    deactivate_flight()
+    install_plan(None)
+
+
+def _train_data(n=400, f=8, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    return resp.status, body
+
+
+# -- latency histograms --------------------------------------------------
+
+def test_latency_histogram_percentiles_vs_numpy():
+    """Log-scale fixed buckets (ratio 10^(1/8)) bound relative quantile
+    error; check against numpy on a lognormal latency-shaped sample."""
+    rs = np.random.RandomState(7)
+    samples = np.exp(rs.randn(5000) * 1.2 + 1.0)    # ms, heavy tail
+    h = LatencyHistogram()
+    for s in samples:
+        h.observe(float(s))
+    for q in (0.50, 0.90, 0.99):
+        est = h.percentile(q)
+        ref = float(np.percentile(samples, q * 100))
+        assert est == pytest.approx(ref, rel=0.2), (q, est, ref)
+    assert h.count == 5000
+    assert h.min == pytest.approx(samples.min())
+    assert h.max == pytest.approx(samples.max())
+
+
+def test_latency_histogram_edge_cases():
+    h = LatencyHistogram()
+    assert h.percentile(0.5) is None
+    h.observe(2.5)
+    # single sample: every percentile clamps to the observed value
+    assert h.percentile(0.01) == pytest.approx(2.5)
+    assert h.percentile(0.99) == pytest.approx(2.5)
+    snap = h.snapshot()
+    assert snap["count"] == 1
+    assert snap["p50_ms"] == pytest.approx(2.5)
+    assert len(snap["buckets"]) == 1      # sparse: only nonzero buckets
+    # overflow bucket serializes as the string "inf"
+    h2 = LatencyHistogram()
+    h2.observe(LATENCY_BUCKET_EDGES_MS[-1] * 10)
+    assert h2.snapshot()["buckets"][0][0] == "inf"
+
+
+def test_registry_latency_feeds_record_and_gauges():
+    reg = obs.MetricsRegistry()
+    reg.begin_iteration(0, now=0.0)
+    for ms in (1.0, 2.0, 4.0):
+        reg.observe_latency("lat.phase.hist", ms)
+    rec = reg.end_iteration(now=1.0)
+    assert "lat" in rec
+    snap = rec["lat"]["lat.phase.hist"]
+    assert snap["count"] == 3
+    assert rec["gauges"]["lat.phase.hist.p50_ms"] == snap["p50_ms"]
+    assert obs.validate_record(rec) == []
+
+
+def test_validate_record_rejects_bad_lat_and_fleet():
+    reg = obs.MetricsRegistry()
+    reg.begin_iteration(0, now=0.0)
+    reg.observe_latency("lat.x", 1.0)
+    rec = reg.end_iteration(now=1.0)
+    bad = json.loads(json.dumps(rec))
+    bad["lat"]["lat.x"]["buckets"] = [["zzz", 1]]
+    assert obs.validate_record(bad)
+    bad2 = json.loads(json.dumps(rec))
+    bad2["fleet"] = {"ranks": 1}
+    assert obs.validate_record(bad2)
+
+
+# -- fleet aggregation ---------------------------------------------------
+
+def test_fleet_aggregator_merges_injected_ranks():
+    """A fake 4-rank gather: skew, slowest rank, per-rank deltas and
+    the persistent straggler table all derive from the stacked
+    payloads."""
+    reg = obs.MetricsRegistry()
+    agg = FleetAggregator()
+
+    def gather4(vec):
+        rows = [np.asarray(vec, dtype=np.float64)]
+        for r in (1, 2, 3):
+            row = rows[0].copy()
+            row[0] *= (1.0 + r)      # rank 3 is slowest
+            row[1] += 100 * r        # distinct coll bytes
+            rows.append(row)
+        return np.stack(rows)
+
+    reg.inc("collective.psum.bytes", 1000)
+    reg.inc("collective.psum.calls", 2)
+    fleet = agg.step(reg, 0.1, _gather=gather4)
+    assert fleet["ranks"] == 4
+    assert fleet["slowest_rank"] == 3
+    assert fleet["iter_max_s"] == pytest.approx(0.4)
+    assert fleet["skew"] > 0
+    assert reg.gauges["coll.slowest_rank"] == 3
+    assert [r["rank"] for r in fleet["per_rank"]] == [0, 1, 2, 3]
+    assert fleet["per_rank"][3]["coll_bytes"] == 1300
+    fleet2 = agg.step(reg, 0.1, _gather=gather4)
+    assert fleet2["per_rank"][3]["slowest_count"] == 2
+    assert agg.table()[3]["slowest_count"] == 2
+
+
+def test_fleet_single_process_records_one_rank(tmp_path):
+    """End-to-end: a single-process train with metrics_file emits a
+    1-rank fleet object on every record, and it validates."""
+    X, y = _train_data()
+    mf = str(tmp_path / "m.jsonl")
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "metrics_file": mf}, ds, num_boost_round=3)
+    recs = [json.loads(line) for line in open(mf)]
+    assert len(recs) == 3
+    for rec in recs:
+        assert rec["fleet"]["ranks"] == 1
+        assert rec["fleet"]["per_rank"][0]["rank"] == 0
+        assert obs.validate_record(rec) == []
+
+
+def test_fleet_off_keeps_straggler_fallback(tmp_path):
+    X, y = _train_data()
+    mf = str(tmp_path / "m.jsonl")
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "metrics_file": mf, "fleet_metrics": False},
+              ds, num_boost_round=2)
+    recs = [json.loads(line) for line in open(mf)]
+    assert all("fleet" not in rec for rec in recs)
+
+
+def test_lightweight_session_marginal_syncs(tmp_path):
+    """obs_port/flight-only sessions must NOT add blocking syncs: the
+    engine keeps the pipelined loop. Count the session's own traced
+    fetches (lat.fetch.*) across two run lengths — the marginal count
+    per extra iteration stays within the pipelined loop's budget of at
+    most one trailing resolve fetch per iteration."""
+    X, y = _train_data()
+
+    def traced_fetches(rounds):
+        ds = lgb.Dataset(X, label=y)
+        seen = {}
+        orig_close = obs.TelemetrySession.close
+
+        def spy_close(self):
+            reg = self.registry
+            seen["n"] = sum(
+                h.count for name, h in reg.latency_histograms().items()
+                if name.startswith("lat.fetch."))
+            seen["lightweight"] = self.lightweight
+            orig_close(self)
+        obs.TelemetrySession.close = spy_close
+        try:
+            lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbose": -1,
+                       "flight_slo_factor": 0.0, "obs_port": 0,
+                       "fleet_metrics": True,
+                       # a lightweight session needs SOME obs switch on;
+                       # port 0 is off, so use a throwaway flight dir
+                       "flight_dir": str(tmp_path / "fl")},
+                      ds, num_boost_round=rounds)
+        finally:
+            obs.TelemetrySession.close = orig_close
+        assert seen["lightweight"] is True
+        return seen["n"]
+
+    base, more = traced_fetches(4), traced_fetches(12)
+    marginal = (more - base) / 8.0
+    assert marginal <= 1.5, (base, more)
+
+
+# -- Prometheus endpoint -------------------------------------------------
+
+def test_render_prometheus_spec():
+    reg = obs.MetricsRegistry()
+    reg.inc("train.trees", 5)
+    reg.set_gauge("mem.live_bytes", 2048.0)
+    reg.observe_latency("lat.fetch.device_get", 0.5)
+    reg.observe_latency("lat.fetch.device_get", 5.0)
+    text = render_prometheus(reg)
+    assert "# TYPE lgbm_tpu_train_trees counter" in text
+    assert "lgbm_tpu_train_trees 5" in text
+    assert "# TYPE lgbm_tpu_mem_live_bytes gauge" in text
+    assert "# TYPE lgbm_tpu_lat_fetch_device_get_ms histogram" in text
+    assert 'lgbm_tpu_lat_fetch_device_get_ms_bucket{le="+Inf"} 2' in text
+    assert "lgbm_tpu_lat_fetch_device_get_ms_count 2" in text
+    assert "lgbm_tpu_lat_fetch_device_get_ms_sum 5.5" in text
+    # cumulative le buckets: counts are monotone non-decreasing
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("lgbm_tpu_lat_fetch_device_get_ms_bucket")]
+    assert cums == sorted(cums)
+    assert render_prometheus(None).startswith("# no active")
+
+
+def test_endpoints_reflect_tripped_sentinel_and_fleet():
+    reg = obs.MetricsRegistry()
+    reg.inc("health.sentinel_trips")
+    reg.inc("health.nan")
+    reg.inc("health.degraded", 2)
+    obs_registry.activate(reg)
+    agg = FleetAggregator()
+    agg.step(reg, 0.25)              # single-process 1-rank view
+    obs.activate_aggregator(agg)
+    srv = ObsServer(0, registry=reg)
+    port = srv.start()
+    try:
+        st, body = _get(port, "/healthz")
+        assert st == 200              # sentinel trips alone are not fatal
+        doc = json.loads(body)
+        assert doc["sentinel"]["trips"] == 1
+        assert doc["sentinel"]["nan"] == 1
+        assert doc["degraded_rungs"] == ["pipeline", "device_eval"]
+        st, body = _get(port, "/statusz")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["fleet"]["ranks"] == 1
+        st, _ = _get(port, "/bogus")
+        assert st == 404
+    finally:
+        srv.stop()
+
+
+def test_healthz_503_on_tripped_watchdog():
+    from lightgbm_tpu.robust.watchdog import (Watchdog, activate_watchdog,
+                                              deactivate_watchdog)
+    wd = Watchdog(1000.0, trace_path="unused_trace.json")
+    wd.tripped = {"message": "stalled", "stall_class": "iteration"}
+    activate_watchdog(wd)
+    srv = ObsServer(0)
+    port = srv.start()
+    try:
+        st, body = _get(port, "/healthz")
+        assert st == 503
+        doc = json.loads(body)
+        assert doc["status"] == "tripped"
+        assert doc["watchdog"]["diagnosis"]["stall_class"] == "iteration"
+    finally:
+        srv.stop()
+        deactivate_watchdog(wd)
+
+
+def test_obs_server_binds_loopback_by_default():
+    srv = ObsServer(0)
+    assert srv.bind == "127.0.0.1"
+    try:
+        port = srv.start()
+        assert port > 0
+        assert srv.port == port
+        assert srv.start() == port    # idempotent
+    finally:
+        srv.stop()
+    srv.stop()                        # double-stop is a no-op
+
+
+# -- flight recorder -----------------------------------------------------
+
+def test_flight_slo_fires_and_cooldown(tmp_path):
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=3.0,
+                        cooldown_s=1000.0)
+    # warmup window: steady 10ms iterations arm the rolling p50
+    for i in range(10):
+        fr.observe_iteration(i, 0.010)
+    assert fr.dumps == 0
+    fr.observe_iteration(10, 0.050)   # 5x the p50: breach
+    assert fr.dumps == 1
+    bundles = os.listdir(str(tmp_path / "fl"))
+    assert len(bundles) == 1
+    man = json.load(open(os.path.join(str(tmp_path / "fl"), bundles[0],
+                                      "manifest.json")))
+    assert man["trigger"] == "slo"
+    assert man["info"]["wall_s"] == pytest.approx(0.05)
+    # cooldown: a second breach right after does not dump again
+    fr.observe_iteration(11, 0.060)
+    assert fr.dumps == 1
+
+
+def test_flight_slo_does_not_fire_on_steady_traffic(tmp_path):
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=4.0)
+    for i in range(50):
+        fr.observe_iteration(i, 0.010 + 0.001 * (i % 3))
+    assert fr.dumps == 0
+    assert not os.path.isdir(str(tmp_path / "fl"))
+
+
+def test_flight_bundle_contents_and_context(tmp_path):
+    reg = obs.MetricsRegistry()
+    reg.inc("train.trees", 2)
+    obs_registry.activate(reg)
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=0.0,
+                        context={"config": "[task: train]",
+                                 "trace_signature": "abc123"})
+    out = fr.dump("manual", {"why": "test"})
+    assert out is not None
+    files = sorted(os.listdir(out))
+    assert {"manifest.json", "registry.json", "stacks.txt"} <= set(files)
+    man = json.load(open(os.path.join(out, "manifest.json")))
+    assert man["trigger"] == "manual"
+    assert man["trace_signature"] == "abc123"
+    regdoc = json.load(open(os.path.join(out, "registry.json")))
+    assert regdoc["counters"]["train.trees"] == 2
+    stacks = open(os.path.join(out, "stacks.txt")).read()
+    assert threading.current_thread().name in stacks
+    assert reg.counters["flight.dumps"] == 1
+    assert reg.counters["flight.manual"] == 1
+
+
+def test_flight_dump_is_atomic_under_write_fault(tmp_path):
+    """A mid-bundle write failure must leave no partial bundle — the
+    tmp staging dir is removed and nothing is renamed in."""
+    reg = obs.MetricsRegistry()
+    obs_registry.activate(reg)
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=0.0,
+                        cooldown_s=0.0)
+    install_plan("sink.write:ioerror")
+    out = fr.dump("manual", {})
+    install_plan(None)
+    assert out is None
+    root = str(tmp_path / "fl")
+    leftovers = os.listdir(root) if os.path.isdir(root) else []
+    assert leftovers == [], leftovers
+    assert reg.counters.get("flight.failed") == 1
+    assert "flight.dumps" not in reg.counters
+    # the recorder recovers once the fault clears
+    assert fr.dump("manual", {}) is not None
+
+
+def test_flight_prunes_old_bundles(tmp_path):
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=0.0,
+                        cooldown_s=0.0)
+    for _ in range(10):
+        fr.dump("manual", {})
+    assert len(os.listdir(str(tmp_path / "fl"))) == 8
+
+
+def test_sentinel_trip_dumps_flight_bundle(tmp_path):
+    """The LGBM_TPU_FAULT_PLAN drill: a poisoned plane trips the
+    sentinel mid-train and the flight recorder captures a bundle."""
+    X, y = _train_data()
+    fd = str(tmp_path / "fl")
+    install_plan("sentinel.check:nan@2")
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "flight_dir": fd, "numeric_sentinels": True},
+              ds, num_boost_round=4)
+    install_plan(None)
+    bundles = os.listdir(fd)
+    assert any(b.endswith("_sentinel") for b in bundles), bundles
+    assert not any(b.startswith(".tmp_") for b in bundles)
+
+
+# -- trace merge + CLI ---------------------------------------------------
+
+def test_merge_trace_events_assigns_rank_pids():
+    from lightgbm_tpu.obs.trace import merge_trace_events
+    r0 = [{"ph": "M", "name": "process_name", "pid": 0,
+           "args": {"name": "old"}},
+          {"ph": "X", "name": "hist", "cat": "phase", "pid": 0, "tid": 1,
+           "ts": 0.0, "dur": 5.0}]
+    r1 = [{"ph": "X", "name": "hist", "cat": "phase", "pid": 0, "tid": 1,
+           "ts": 1.0, "dur": 7.0}]
+    doc = merge_trace_events([r0, r1])
+    assert doc["otherData"]["merged_ranks"] == 2
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["pid"] for e in xs) == [0, 1]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"
+             and e["name"] == "process_name"]
+    names = {e["pid"]: e["args"]["name"] for e in metas}
+    assert names[1] == "lightgbm_tpu rank 1"
+
+
+def test_trace_report_flight_cli(tmp_path):
+    from lightgbm_tpu.cli import main as cli_main
+    reg = obs.MetricsRegistry()
+    obs_registry.activate(reg)
+    fr = FlightRecorder(str(tmp_path / "fl"), slo_factor=0.0)
+    assert fr.dump("manual", {"iteration": 3}) is not None
+    assert cli_main(["trace-report", "--flight",
+                     str(tmp_path / "fl")]) == 0
+    assert cli_main(["trace-report", "--flight",
+                     str(tmp_path / "nope")]) == 2
+
+
+# -- sink dead-letter counter --------------------------------------------
+
+def test_disabled_sink_counts_dropped_payloads(tmp_path):
+    # a missing parent dir disables the sink at open time
+    sink = obs.JsonlSink(str(tmp_path / "missing_dir" / "x.jsonl"))
+    assert sink.disabled
+    sink.write({"a": 1})
+    sink.write({"a": 2})
+    assert sink.dropped == 2
+
+
+def test_session_with_dead_sink_skips_write_and_counts(tmp_path):
+    X, y = _train_data()
+    mf = str(tmp_path / "m.jsonl")
+    seen = {}
+    orig_start = obs.TelemetrySession.start
+    orig_close = obs.TelemetrySession.close
+
+    def spy_start(self):
+        orig_start(self)
+        self.sink.close()              # kill the sink under the session
+
+    def spy_close(self):
+        seen["dropped"] = self.sink.dropped
+        seen["counter"] = self.registry.counters.get(
+            "sink.dropped_payloads", 0)
+        orig_close(self)
+    obs.TelemetrySession.start = spy_start
+    obs.TelemetrySession.close = spy_close
+    try:
+        ds = lgb.Dataset(X, label=y)
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                   "metrics_file": mf}, ds, num_boost_round=3)
+    finally:
+        obs.TelemetrySession.start = orig_start
+        obs.TelemetrySession.close = orig_close
+    assert seen["dropped"] == 3
+    assert seen["counter"] == 3
+
+
+# -- config / signature seams --------------------------------------------
+
+def test_obs_params_and_aliases():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"obs_http_port": "9464",
+                              "flight_recorder_dir": "/tmp/fl",
+                              "fleet_telemetry": "false",
+                              "flight_slo_factor": "-1"})
+    assert cfg.obs_port == 9464
+    assert cfg.flight_dir == "/tmp/fl"
+    assert cfg.fleet_metrics is False
+    assert cfg.flight_slo_factor == 0.0     # clamped non-negative
+
+
+def test_obs_params_do_not_move_compile_signature():
+    from lightgbm_tpu.compile.signature import config_signature
+    from lightgbm_tpu.config import Config
+    a = config_signature(Config.from_params({}))
+    b = config_signature(Config.from_params(
+        {"obs_port": "9464", "flight_dir": "/tmp/fl",
+         "flight_slo_factor": "8", "fleet_metrics": "false"}))
+    assert a == b
+
+
+def test_cli_obs_flags():
+    from lightgbm_tpu.cli import parse_args
+    params = parse_args(["train", "--obs-port", "9464",
+                         "--flight-dir=/tmp/fl"])
+    assert params["obs_port"] == "9464"
+    assert params["flight_dir"] == "/tmp/fl"
