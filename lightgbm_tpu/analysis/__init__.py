@@ -1,6 +1,6 @@
 """tpulint — project-specific static analysis for lightgbm_tpu.
 
-Seven rule packs over a plain-`ast` model of the package (core.py).
+Nine rule packs over a plain-`ast` model of the package (core.py).
 Host-side (PR 4):
 
 - trace-safety      implicit tracer concretization inside jitted code
@@ -21,6 +21,16 @@ baseline):
 - dtype-flow        narrow-dtype accumulation and dequantize-before-
                     subtract in the quantized histogram pipeline
 
+Lifetime/threading ("lifelint", same infrastructure):
+
+- buffer-lifetime    use-after-donate through the compile-manager
+                     entries, device refs escaping into checkpoints /
+                     flight bundles / telemetry, undrained
+                     copy_to_host_async trailing-fetch handles
+- thread-shared-state  thread-spawn inventory + lock discipline by
+                     thread-reachability: attrs reachable from more
+                     than one thread mutate under a lock or a pragma
+
 Run `python -m lightgbm_tpu.analysis` (exit 0 = clean against the
 checked-in baseline), or call `run()` programmatically. The rule
 catalogue, pragma syntax, and baseline workflow are documented in
@@ -40,8 +50,8 @@ from .core import (  # noqa: F401  (re-exported API)
     load_baseline,
     save_baseline,
 )
-from . import (collective_axis, dtype_flow, kernel_contract, locks,
-               recompile, sync_points, trace_safety)
+from . import (collective_axis, dtype_flow, kernel_contract, lifetime,
+               locks, recompile, sync_points, threads, trace_safety)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
@@ -54,13 +64,17 @@ RULE_PACKS = {
     "collective-axis": collective_axis.check,
     "kernel-contract": kernel_contract.check,
     "dtype-flow": dtype_flow.check,
+    "buffer-lifetime": lifetime.check,
+    "thread-shared-state": threads.check,
 }
 
-# rule name -> per-pack obs gauge (schema minor 4)
+# rule name -> per-pack obs gauge (schema minor 4; lifelint pair minor 12)
 _PACK_GAUGES = {
     "collective-axis": "lint.mesh_findings",
     "kernel-contract": "lint.tile_findings",
     "dtype-flow": "lint.dtype_findings",
+    "buffer-lifetime": "lint.life_findings",
+    "thread-shared-state": "lint.thread_findings",
 }
 
 

@@ -62,10 +62,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         result.new.sort(key=lambda f: (f.path, f.line, f.rule, f.code))
 
     if args.format == "json":
+        # by_pack: every ENABLED pack with its new-finding count, zero
+        # included — the CI artifact must show a pack ran and was
+        # clean, not merely omit it
+        enabled = [r for r in RULE_PACKS if rules is None or r in rules]
+        by_rule = summary(result)
         print(json.dumps({
             "ok": result.ok,
-            "by_rule": summary(result),
-            "new": [vars(f) for f in result.new],
+            "by_rule": by_rule,
+            "by_pack": {r: by_rule.get(r, 0) for r in enabled},
+            "new": [{**vars(f), "location": f"{f.path}:{f.line}"}
+                    for f in result.new],
             "baselined": len(result.baselined),
             "baseline_size": result.baseline_size,
             "hot_sync_count": result.hot_sync_count,

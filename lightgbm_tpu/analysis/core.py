@@ -20,8 +20,8 @@ Pragmas
 -------
 `# tpulint: <kind>(<reason>)` on the offending line, or alone on the
 line directly above it. Kinds: `sync-ok`, `jit-ok`, `trace-ok`,
-`lock-ok`, `switch-ok`, plus the meshlint kinds `mesh-ok`, `tile-ok`,
-`dtype-ok`.
+`lock-ok`, `switch-ok`, the meshlint kinds `mesh-ok`, `tile-ok`,
+`dtype-ok`, plus the lifelint kinds `donate-ok`, `thread-ok`.
 The reason is mandatory — a bare pragma is itself a finding.
 
 Findings & baseline
@@ -43,7 +43,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 PRAGMA_RE = re.compile(r"#\s*tpulint:\s*([a-z-]+)\s*(?:\(\s*([^)]*?)\s*\))?")
 PRAGMA_KINDS = ("sync-ok", "jit-ok", "trace-ok", "lock-ok",
-                "switch-ok", "mesh-ok", "tile-ok", "dtype-ok")
+                "switch-ok", "mesh-ok", "tile-ok", "dtype-ok",
+                "donate-ok", "thread-ok")
 
 # numpy / jax module spellings recognized as import roots
 _NUMPY_MODULES = ("numpy",)

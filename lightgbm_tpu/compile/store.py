@@ -122,6 +122,7 @@ class ExecutableStore:
 
     def env_dir(self) -> str:
         if self._env_dir is None:
+            # tpulint: thread-ok(idempotent lazy cache; racing threads compute equal paths)
             self._env_dir = os.path.join(self.root, S.environment_key())
         return self._env_dir
 

@@ -43,7 +43,11 @@ Iteration record (v1.2):
             p90_ms, p99_ms, buckets: [[le_ms | "inf", count], ...]}),
             fleet (object, minor 11: pod-level view merged by
             obs/aggregate.py — ranks, iter_min/mean/max_s, skew,
-            skew_trend, slowest_rank, per_rank straggler table),
+            skew_trend, slowest_rank, per_rank straggler table;
+            minor 12 adds the per-pack lifelint gauges
+            "lint.life_findings" / "lint.thread_findings" under
+            `gauges` — buffer-lifetime and thread-shared-state
+            finding counts),
             metrics (object: "<dataset>/<metric>" -> number),
             num_leaves (int), best_gain (number)
 
@@ -53,6 +57,7 @@ driver artifacts wrap it under a "parsed" key).
 from __future__ import annotations
 
 import json
+import threading
 from typing import Any, Dict, List
 
 SCHEMA_VERSION = 1
@@ -91,8 +96,11 @@ SCHEMA_VERSION = 1
 # per-rank object, the flight.dumps / flight.<trigger> /
 # flight.failed / slo.breaches / sink.dropped_payloads counters, plus
 # the iter_p99_s / fetch_p99_ms / obs_overhead_pct bench summary
-# fields)
-SCHEMA_MINOR = 11
+# fields), to 12 when the lifelint packs joined (the per-pack
+# lint.life_findings / lint.thread_findings gauges under `gauges` —
+# buffer-lifetime and thread-shared-state finding counts, matching the
+# minor-4 meshlint per-pack gauges)
+SCHEMA_MINOR = 12
 
 _REQUIRED_NUM = ("t_iter_s", "t_hist_s", "t_split_s", "t_partition_s",
                  "t_other_s")
@@ -275,6 +283,9 @@ class JsonlSink:
     def __init__(self, path: str) -> None:
         self.path = path
         self.dropped = 0
+        # watchdog trips and flight-recorder dumps write from their own
+        # threads; RLock because the write() error path calls _disable()
+        self._lock = threading.RLock()
         try:
             self._fh = open(path, "w")
         except OSError as exc:
@@ -290,32 +301,35 @@ class JsonlSink:
         log.warning("Metrics sink %s disabled after I/O error (%s); "
                     "training continues without JSONL metrics",
                     self.path, exc)
-        if self._fh is not None:
-            try:
-                self._fh.close()
-            except OSError:
-                pass
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
     def write(self, record: Dict[str, Any]) -> None:
-        if self._fh is None:
-            self.dropped += 1
-            return
-        try:
-            from ..robust.faultinject import check_fault
-            check_fault("sink.write")
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
-        except OSError as exc:
-            self._disable(exc)
+        with self._lock:
+            if self._fh is None:
+                self.dropped += 1
+                return
+            try:
+                from ..robust.faultinject import check_fault
+                check_fault("sink.write")
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
+            except OSError as exc:
+                self._disable(exc)
 
     def close(self) -> None:
-        if self._fh is not None:
-            try:
-                self._fh.close()
-            except OSError:
-                pass
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:

@@ -211,8 +211,8 @@ class Watchdog:
                 fr.dump("watchdog", diagnosis)
         except Exception:
             pass
-        self.trip_count += 1
         with self._lock:
+            self.trip_count += 1
             self.tripped = diagnosis
 
     # -- diagnostics (all best-effort: run on the watchdog thread) ------

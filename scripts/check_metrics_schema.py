@@ -35,7 +35,10 @@ Two artifact kinds (docs/OBSERVABILITY.md):
   latency-histogram map (fixed log-scale buckets with derived
   p50/p90/p99 gauges) and `fleet` fleet-merged per-rank block, the
   `flight.*` / `slo.*` / `sink.*` counters, and the `iter_p99_s` /
-  `fetch_p99_ms` / `obs_overhead_pct` bench summary fields),
+  `fetch_p99_ms` / `obs_overhead_pct` bench summary fields; v1.12
+  adds the per-pack lifelint gauges `lint.life_findings` /
+  `lint.thread_findings` — buffer-lifetime and thread-shared-state
+  finding counts),
 - bench summary JSON: either the raw one-line output of bench.py or the
   driver's BENCH_*.json wrapper, which nests the parsed line under a
   "parsed" key (`obs.sink.validate_bench_record` unwraps it). bench.py

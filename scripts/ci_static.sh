@@ -6,7 +6,8 @@
 #     scripts/ci_static.sh [artifact-dir]
 #
 # Exit 0 = clean. Artifacts: <dir>/tpulint.json (always; the --json
-# payload of all seven rule packs) and the ruff findings on stdout.
+# payload of all nine rule packs, with a by_pack rollup and
+# per-finding locations) and the ruff findings on stdout.
 # ruff is optional in the container image: when it is not installed
 # the ruff stage is skipped with a note — tpulint still gates.
 set -euo pipefail

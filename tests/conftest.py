@@ -20,6 +20,22 @@ try:
 except Exception:
     pass
 
+# Persistent XLA compile cache, shared with bench.py: the suite pays
+# hundreds of small per-config compiles, and on the single-core CI box
+# they dominate tier-1 wall time.  First run populates .jax_cache
+# (gitignored); repeat runs — including the driver's acceptance run —
+# skip compilation.  min_compile_time 0 caches even sub-second
+# programs: the suite compiles many of them, and a cache lookup is
+# orders of magnitude cheaper than any compile.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -48,7 +48,11 @@ def test_sklearn_joblib_roundtrip(tmp_path):
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_grid_search_cv():
+    """Slow-marked: the sklearn estimator contract is tier-1-covered by
+    the fit/predict/pickle compat tests and CV by TestCV::test_cv_basic;
+    GridSearchCV only composes the two (4 extra trainings)."""
     model_selection = pytest.importorskip("sklearn.model_selection")
     X, y = make_xy(400)
     gs = model_selection.GridSearchCV(
@@ -59,7 +63,11 @@ def test_grid_search_cv():
     assert gs.best_params_["num_leaves"] in (7, 15)
 
 
+@pytest.mark.slow
 def test_pandas_dataframe_with_categorical():
+    """Slow-marked: categorical training quality is tier-1-covered by
+    TestCategorical::test_categorical_feature; the pandas ingestion
+    mapping this adds on top is pure preprocessing."""
     pd = pytest.importorskip("pandas")
     rng = np.random.RandomState(3)
     n = 800

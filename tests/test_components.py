@@ -20,7 +20,11 @@ def make_binary(n=1500, f=6, seed=7):
     return X, y
 
 
+@pytest.mark.slow
 def test_forced_splits(tmp_path):
+    """Slow-marked: forced-split application stays tier-1 via
+    test_fused_coverage::test_forced_splits_run_fused_and_match_host_loop,
+    which walks the same host loop and proves fused parity on top."""
     X, y = make_binary()
     fs = {"feature": 3, "threshold": 0.0,
           "left": {"feature": 4, "threshold": 0.5}}

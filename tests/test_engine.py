@@ -57,14 +57,20 @@ class TestObjectives:
         p = bst.predict(X)
         assert np.mean((p - y) ** 2) < 0.4
 
+    @pytest.mark.slow
     def test_regression_l1(self):
+        """Slow-marked: l1 stays tier-1-covered via test_regression (l2
+        gradient path) and the fused renew l1 param in test_renew_fused."""
         X, y = make_regression()
         bst = lgb.train(dict(P, objective="regression_l1"),
                         lgb.Dataset(X, label=y), num_boost_round=50,
                         verbose_eval=False)
         assert np.mean(np.abs(bst.predict(X) - y)) < 0.6
 
+    @pytest.mark.slow
     def test_huber_fair_quantile(self):
+        """Slow-marked: pure objective numerics; the quantile/renew
+        fused param in test_renew_fused keeps quantile tier-1."""
         X, y = make_regression(1200)
         for obj in ("huber", "fair"):
             bst = lgb.train(dict(P, objective=obj), lgb.Dataset(X, label=y),
@@ -76,7 +82,11 @@ class TestObjectives:
                        verbose_eval=False)
         assert (bq.predict(X) > y).mean() > 0.7
 
+    @pytest.mark.slow
     def test_poisson_gamma_tweedie(self):
+        """Slow-marked: pure log-link objective numerics with no kernel
+        or layout coupling; the shared gradient path is tier-1-covered
+        by the l2/binary/multiclass objectives."""
         rng = np.random.RandomState(5)
         X = rng.randn(1500, 6)
         lam = np.exp(0.5 * X[:, 0] + 0.3 * X[:, 1])
@@ -109,7 +119,10 @@ class TestObjectives:
         np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
         assert (np.argmax(p, 1) == y).mean() > 0.9
 
+    @pytest.mark.slow
     def test_multiclassova(self):
+        """Slow-marked: softmax multiclass (test_multiclass) keeps the
+        num_class output layout tier-1; ova only swaps the link."""
         rng = np.random.RandomState(9)
         X = rng.randn(1500, 6)
         y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
@@ -119,7 +132,11 @@ class TestObjectives:
         p = bst.predict(X)
         assert (np.argmax(p, 1) == y).mean() > 0.85
 
+    @pytest.mark.slow
     def test_cross_entropy(self):
+        """Slow-marked: the sigmoid-link gradient path stays tier-1 via
+        test_binary; cross_entropy only relaxes labels to probabilities
+        on the same link."""
         X, y = make_binary()
         yp = 0.8 * y + 0.1  # probability labels
         bst = lgb.train(dict(P, objective="cross_entropy"),
@@ -236,7 +253,11 @@ class TestCategorical:
         p = bst.predict(X)
         assert ((p > 0.5) == y).mean() > 0.97
 
+    @pytest.mark.slow
     def test_categorical_onehot(self):
+        """Slow-marked: the categorical split rule stays tier-1 via
+        test_categorical_feature; this variant only drops cardinality
+        under max_cat_to_onehot to take the one-vs-rest branch."""
         rng = np.random.RandomState(22)
         n = 1000
         cat = rng.randint(0, 3, n)  # <= max_cat_to_onehot
@@ -251,7 +272,11 @@ class TestCategorical:
 
 
 class TestTrainingControl:
+    @pytest.mark.slow
     def test_early_stopping(self):
+        """Slow-marked: early stopping stays tier-1 via
+        test_pipeline::test_early_stop_parity (same callback picking
+        the same best_iteration, pipelined and synchronous)."""
         X, y = make_binary(3000)
         ds = lgb.Dataset(X[:2000], label=y[:2000])
         vs = ds.create_valid(X[2000:], label=y[2000:])
@@ -323,7 +348,11 @@ class TestTrainingControl:
         bag = perm[:g.bag_data_cnt]
         assert np.array_equal(bag, np.sort(bag))  # stable ascending bag
 
+    @pytest.mark.slow
     def test_dart(self):
+        """Slow-marked: the DART drop/normalize path stays tier-1 via
+        test_pipeline::test_dart_parity; this re-proves training
+        quality on top of the same boosting mode."""
         X, y = make_binary()
         bst = lgb.train(dict(P, objective="binary", boosting="dart",
                              drop_rate=0.3), lgb.Dataset(X, label=y),
@@ -455,7 +484,11 @@ class TestCV:
                      early_stopping_rounds=3)
         assert len(res["binary_logloss-mean"]) < 100
 
+    @pytest.mark.slow
     def test_cv_return_booster(self):
+        """Slow-marked: fold construction and metric aggregation are
+        tier-1-covered by test_cv_basic; this only checks the
+        return_cvbooster plumbing on top of the same folds."""
         X, y = make_binary(800)
         res = lgb.cv(dict(P, objective="binary"), lgb.Dataset(X, label=y),
                      num_boost_round=5, nfold=3, return_cvbooster=True)

@@ -1,0 +1,540 @@
+"""lifelint: the buffer-lifetime and thread-shared-state rule packs.
+
+Same three layers as test_tpulint.py / test_meshlint.py: fixture tests
+seeding one violation per rule (plus the annotated/structured negative
+twin), the package-wide zero-findings gate per pack, and slow runtime
+shadow-checks — the live compile manager's donating entries must be a
+subset of the static donation inventory, and every live `lgbm-*`
+thread must appear in the static spawn inventory.
+
+Everything except the slow checks is pure `ast` — no jax import, no
+jit — so this file adds ~seconds to tier-1, not minutes.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.analysis import DEFAULT_BASELINE, collect, lifetime
+from lightgbm_tpu.analysis import runtime_check, threads
+from lightgbm_tpu.analysis.core import Package, load_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REPO_PKG = None
+
+
+def repo_pkg():
+    global _REPO_PKG
+    if _REPO_PKG is None:
+        _REPO_PKG = Package.load(REPO_ROOT)
+    return _REPO_PKG
+
+
+def make_pkg(tmp_path, files):
+    """Synthetic package: {relpath under lightgbm_tpu/: source}."""
+    for rel, src in files.items():
+        p = tmp_path / "lightgbm_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Package.load(str(tmp_path))
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# -------------------------------------------------- use-after-donate
+
+def test_use_after_donate_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(1,))
+
+            def step(self, state):
+                out = self._jit(None, state)
+                return state, out
+        """})
+    assert "use-after-donate:state" in codes(lifetime.check(pkg))
+
+
+def test_rebind_kills_donation(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(1,))
+
+            def same_stmt(self, state):
+                state = self._jit(None, state)
+                return state
+
+            def later_rebind(self, state):
+                out = self._jit(None, state)
+                state = out[0]
+                return state
+        """})
+    assert lifetime.check(pkg) == []
+
+
+def test_donate_ok_pragma_suppresses(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(1,))
+
+            def step(self, state):
+                out = self._jit(None, state)
+                # tpulint: donate-ok(cpu-only diagnostic readback)
+                host = state.sum()
+                return out, host
+        """})
+    assert lifetime.check(pkg) == []
+
+
+def test_star_args_local_tuple_expanded(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(0,))
+
+            def step(self, data, extra):
+                args = (data, extra)
+                out = self._jit(*args)
+                total = data.sum()
+                return out, total
+        """})
+    assert "use-after-donate:data" in codes(lifetime.check(pkg))
+
+
+def test_closure_escape_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(1,))
+
+            def step(self, state):
+                out = self._jit(None, state)
+                self._cb = lambda: state.sum()
+                return out
+        """})
+    assert "donate-escape-closure:state" in codes(lifetime.check(pkg))
+
+
+def test_bare_jit_local_binding(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        import jax
+
+        def run(f, state):
+            step = jax.jit(f, donate_argnums=0)
+            new = step(state)
+            return state, new
+        """})
+    assert "use-after-donate:state" in codes(lifetime.check(pkg))
+
+
+def test_wrapper_method_forwards_donation(tmp_path):
+    """A method that forwards a param into a donated position donates
+    that param at ITS call sites (the train_iter_persistent shape)."""
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = shared_entry("t/iter", sig, build,
+                                         donate_argnums=(1,))
+
+            def train_once(self, data):
+                return self._jit(None, data)
+
+        def drive(grower, batch):
+            out = grower.train_once(batch)
+            return batch.sum(), out
+        """})
+    assert "use-after-donate:batch" in codes(lifetime.check(pkg))
+
+
+# ------------------------------------------------- donation inventory
+
+def test_instrument_kernel_transparent(tmp_path):
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        class Grower:
+            def __init__(self, sig, build):
+                self._jit = instrument_kernel(
+                    shared_entry("t/wrapped", sig, build,
+                                 donate_argnums=(0,)), "wrapped")
+
+            def step(self, state):
+                out = self._jit(state)
+                return state, out
+        """})
+    inv = lifetime.donation_inventory(pkg)
+    assert "t/wrapped" in {s.entry_name for s in inv}
+    assert "use-after-donate:state" in codes(lifetime.check(pkg))
+
+
+def test_call_receiver_factory_recognized(tmp_path):
+    """`get_manager().shared_entry(...)` — an attribute chain bottoming
+    out at a Call — must still register (the parallel.py mc shape)."""
+    pkg = make_pkg(tmp_path, {"life.py": """\
+        from ..compile.manager import get_manager
+
+        def register(sig, build):
+            jit = get_manager().shared_entry("t/mc", sig, build,
+                                             donate_argnums=(0,))
+            return jit
+        """})
+    inv = lifetime.donation_inventory(pkg)
+    assert "t/mc" in {s.entry_name for s in inv}
+
+
+def test_repo_donation_inventory_names():
+    """The real repo's named donating entries — the fused serial loop
+    and the multi-chip persistent loop — must be statically visible;
+    the runtime shadow-check leans on exactly this."""
+    inv = lifetime.donation_inventory(repo_pkg())
+    names = {s.entry_name for s in inv if s.entry_name}
+    assert "fused/train_iter" in names
+    assert "mc/train_iter" in names
+    assert all(s.positions for s in inv)
+
+
+# ------------------------------------------------------ escape rules
+
+def test_escape_checkpoint_flagged_and_laundered(tmp_path):
+    pkg = make_pkg(tmp_path, {"ck.py": """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Learner:
+            def checkpoint_state(self):
+                grads = jnp.zeros(4)
+                state = {}
+                state["grads"] = grads
+                state["ok"] = np.asarray(grads)
+                return state
+        """})
+    found = lifetime.check(pkg)
+    assert "escape-checkpoint" in codes(found)
+    # the laundered store is the only clean line: exactly one finding
+    assert len([f for f in found if f.code == "escape-checkpoint"]) == 1
+
+
+def test_escape_flight_and_telemetry(tmp_path):
+    pkg = make_pkg(tmp_path, {"fl.py": """\
+        import jax.numpy as jnp
+
+        def snap(rec, reg):
+            x = jnp.zeros(3)
+            rec.dump("oom", x)
+            reg.set_gauge("loss", x)
+            reg.set_gauge("loss_host", float(x))
+        """})
+    got = codes(lifetime.check(pkg))
+    assert "escape-flight" in got
+    assert "escape-telemetry" in got
+    # float() launders: exactly one telemetry finding
+    found = [f for f in lifetime.check(pkg) if f.code == "escape-telemetry"]
+    assert len(found) == 1
+
+
+# -------------------------------------------------- trailing fetches
+
+def test_fetch_no_drain(tmp_path):
+    pkg = make_pkg(tmp_path, {"fe.py": """\
+        class NoDrain:
+            def __init__(self):
+                self._pending = []
+
+            def fetch(self, arr):
+                arr.copy_to_host_async()
+                self._pending.append(arr)
+        """})
+    assert "fetch-no-drain:NoDrain._pending" in codes(lifetime.check(pkg))
+
+
+def test_fetch_drained_and_ckpt_reaches_drain(tmp_path):
+    pkg = make_pkg(tmp_path, {"fe.py": """\
+        class Drained:
+            def __init__(self):
+                self._pending = []
+
+            def fetch(self, arr):
+                arr.copy_to_host_async()
+                self._pending.append(arr)
+
+            def drain(self):
+                self._pending = []
+
+            def checkpoint_state(self):
+                self.drain()
+                return {}
+        """})
+    assert lifetime.check(pkg) == []
+
+
+def test_fetch_ckpt_live(tmp_path):
+    pkg = make_pkg(tmp_path, {"fe.py": """\
+        class CkptLive:
+            def __init__(self):
+                self._pending = []
+
+            def fetch(self, arr):
+                arr.copy_to_host_async()
+                self._pending.append(arr)
+
+            def drain(self):
+                self._pending = []
+
+            def checkpoint_state(self):
+                return {}
+        """})
+    assert "fetch-ckpt-live:CkptLive._pending" in codes(lifetime.check(pkg))
+
+
+# ------------------------------------------------ thread-shared-state
+
+_COUNTER_SRC = """\
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            self.ok = 0
+
+        def bump(self):
+            self.n += 1
+            with self._lock:
+                self.ok += 1
+
+    def _work(counter):
+        counter.bump()
+
+    def spawn(counter):
+        t = threading.Thread(target=_work, name="lgbm-test-worker",
+                             args=(counter,))
+        t.start()
+"""
+
+
+def test_spawn_inventory_kinds_and_names(tmp_path):
+    pkg = make_pkg(tmp_path, {"th.py": """\
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        from http.server import BaseHTTPRequestHandler
+
+        def _work(x):
+            return x
+
+        def spawn(items):
+            t = threading.Thread(target=_work, name="lgbm-test-worker")
+            t.start()
+            with ThreadPoolExecutor(2) as pool:
+                list(pool.map(_work, items))
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.wfile.write(b"ok")
+        """})
+    sites = threads.spawn_inventory(pkg)
+    assert {s.kind for s in sites} == {"thread", "pool", "handler"}
+    assert threads.thread_names(pkg) == {"lgbm-test-worker"}
+    # thread and pool both resolved their in-package target
+    roots = [s.roots for s in sites if s.kind in ("thread", "pool")]
+    assert all(any(q.endswith("_work") for q in r) for r in roots)
+
+
+def test_unlocked_mutation_on_thread_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"th.py": _COUNTER_SRC})
+    found = threads.check(pkg)
+    assert any(c.startswith("Counter.n:") for c in codes(found))
+    # the locked counter is clean
+    assert not any(c.startswith("Counter.ok:") for c in codes(found))
+
+
+def test_thread_ok_class_pragma_suppresses(tmp_path):
+    pkg = make_pkg(tmp_path, {"th.py": _COUNTER_SRC.replace(
+        "    class Counter:",
+        "    # tpulint: thread-ok(test: torn reads tolerated)\n"
+        "    class Counter:")})
+    assert threads.check(pkg) == []
+
+
+def test_generic_and_external_receivers_do_not_leak(tmp_path):
+    """Precision: `d.update(...)` (builtin-container verb) and
+    `json.dump(...)` (external-import receiver) must NOT pull
+    same-named package methods into the thread-reachable set."""
+    pkg = make_pkg(tmp_path, {"th.py": """\
+        import json
+        import threading
+
+        class State:
+            def update(self, v):
+                self.val = v
+
+        class Rec:
+            def dump(self, tag, payload):
+                self.count = self.count + 1
+
+        def _work(d, payload, fh):
+            d.update(payload)
+            json.dump(payload, fh)
+
+        def spawn():
+            threading.Thread(target=_work, name="lgbm-u").start()
+        """})
+    assert threads.check(pkg) == []
+    reach = threads.thread_reachable(pkg)
+    assert not any(q.endswith("State.update") for q in reach)
+    assert not any(q.endswith("Rec.dump") for q in reach)
+
+
+def test_unknown_receiver_instance_method_does_reach(tmp_path):
+    """The fallback the precision filters must NOT kill: a non-generic
+    method call through an untyped receiver still reaches the unique
+    in-package instance method (the `counter.bump()` shape)."""
+    pkg = make_pkg(tmp_path, {"th.py": _COUNTER_SRC})
+    reach = threads.thread_reachable(pkg)
+    assert any(q.endswith("Counter.bump") for q in reach)
+
+
+# -------------------------------------------- package gates + baseline
+
+def test_package_clean_buffer_lifetime():
+    found = lifetime.check(repo_pkg())
+    assert found == [], "\n".join(map(str, found))
+
+
+def test_package_clean_thread_shared_state():
+    found = threads.check(repo_pkg())
+    assert found == [], "\n".join(map(str, found))
+
+
+def test_repo_spawn_inventory_names():
+    """The fleet of named lgbm-* threads the package spawns must be
+    statically visible (watchdog, obs httpd, warmup, barrier)."""
+    names = threads.thread_names(repo_pkg())
+    for expected in ("lgbm-tpu-watchdog", "lgbm-tpu-obs-httpd",
+                     "lgbm-aot-warmup", "lgbm-tpu-startup-barrier"):
+        assert expected in names, names
+
+
+def test_baseline_shrink_only():
+    """Shrink-only discipline holds for the lifelint packs: no
+    budgeted lifelint key may outlive its finding, and today the
+    baseline carries none — the audit fixed or annotated every hit.
+    (test_tpulint.py runs the all-pack version of this check; the
+    subset keeps this file from re-collecting the whole repo.)"""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    findings = collect(repo_pkg(), ["buffer-lifetime",
+                                    "thread-shared-state"])
+    live_keys = {f.key for f in findings}
+    stale = [k for k in baseline
+             if k.startswith(("buffer-lifetime|", "thread-shared-state|"))
+             and k not in live_keys]
+    assert stale == [], f"baseline keys no longer observed: {stale}"
+    assert not any(k.startswith(("buffer-lifetime|",
+                                 "thread-shared-state|"))
+                   for k in baseline), "lifelint baseline must stay empty"
+
+
+# ----------------------------------------------------------- CLI + obs
+
+def test_cli_json_locations_and_by_pack(tmp_path, capsys):
+    """--json carries per-finding `location` and the by_pack rollup
+    (zero-count packs included) on a seeded-violation tree."""
+    make_pkg(tmp_path, {"th.py": _COUNTER_SRC})
+    from lightgbm_tpu.analysis.__main__ import main
+    rc = main(["--root", str(tmp_path), "--no-baseline", "--json",
+               "--rules", "thread-shared-state"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not payload["ok"]
+    assert payload["by_pack"]["thread-shared-state"] >= 1
+    assert list(payload["by_pack"]) == ["thread-shared-state"]
+    for f in payload["new"]:
+        assert f["location"] == f"{f['path']}:{f['line']}"
+
+
+def test_cli_rules_subset_json_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "--json",
+         "--rules", "buffer-lifetime,thread-shared-state"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] and payload["new"] == []
+    assert payload["by_pack"] == {"buffer-lifetime": 0,
+                                  "thread-shared-state": 0}
+
+
+def test_run_publishes_lifelint_gauges():
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.analysis import run
+    reg = obs.MetricsRegistry()
+    obs.activate(reg)
+    try:
+        run(REPO_ROOT, pkg=repo_pkg(),
+            rules=["buffer-lifetime", "thread-shared-state"])
+        assert reg.gauges.get("lint.life_findings") == 0.0
+        assert reg.gauges.get("lint.thread_findings") == 0.0
+    finally:
+        obs.activate(None)
+
+
+# ------------------------------------------------- runtime cross-check
+
+@pytest.mark.slow
+def test_lifetime_shadow_check_runtime():
+    """Runtime lifetime events ⊆ static inventory: every donating
+    entry the live compile manager registered during a real (fused,
+    default-config) training run must be statically known, and every
+    donation warning jax emits on the CPU tier must be the benign
+    donation-is-a-no-op kind."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(400, 6).astype(np.float32)
+    y = (X[:, 0] + rng.rand(400) > 1.0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    records = []
+    with runtime_check.capture_donation_warnings(records):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                  verbose_eval=False)
+    hostile = [m for m in records
+               if not runtime_check.benign_donation_warning(m)]
+    assert hostile == [], (
+        "donation warnings indicating a live reference to a donated "
+        f"buffer: {hostile}")
+
+    report = runtime_check.lifetime_shadow_check(pkg=repo_pkg())
+    assert "fused/train_iter" in report["runtime_donating"], report
+    assert report["unaccounted"] == [], (
+        "runtime donating entries the static inventory misses: "
+        f"{report}")
+
+
+@pytest.mark.slow
+def test_thread_check_runtime():
+    """Every live lgbm-* thread must be in the static spawn inventory
+    — here the obs endpoint's accept-loop thread."""
+    from lightgbm_tpu.obs import MetricsRegistry
+    from lightgbm_tpu.obs.httpd import ObsServer
+
+    srv = ObsServer(0, registry=MetricsRegistry())
+    try:
+        srv.start()
+        report = runtime_check.thread_check(pkg=repo_pkg())
+        assert "lgbm-tpu-obs-httpd" in report["live"], report
+        assert report["unaccounted"] == [], (
+            f"live threads the static spawn inventory misses: {report}")
+    finally:
+        srv.stop()

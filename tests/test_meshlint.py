@@ -460,7 +460,11 @@ def test_cli_rules_subset_json():
     assert payload["by_rule"] == {}
 
 
+@pytest.mark.slow
 def test_run_publishes_meshlint_gauges():
+    """Slow-marked: pack-generic gauge publication stays tier-1 via
+    test_lifelint::test_run_publishes_lifelint_gauges (two-pack run);
+    the meshlint rules themselves are tier-1 via the fixture tests."""
     from lightgbm_tpu import obs
     from lightgbm_tpu.analysis import run
     reg = obs.MetricsRegistry()

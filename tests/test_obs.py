@@ -357,7 +357,12 @@ def test_record_metrics_callback(tmp_path):
         lgb.record_metrics({})
 
 
+@pytest.mark.slow
 def test_early_stopping_closes_telemetry(tmp_path):
+    """Slow-marked: session closure on the normal unwind stays tier-1
+    via test_train_writes_one_valid_line_per_iteration, and early
+    stopping via test_pipeline::test_early_stop_parity; this composes
+    the two (EarlyStopException unwinding through the session)."""
     X, y = _train_data()
     rs = np.random.RandomState(7)
     Xv = rs.randn(100, X.shape[1]).astype(np.float32)

@@ -68,7 +68,11 @@ def test_pathforest_missing_values_match_walker():
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pathforest_rejects_categorical_models():
+    """Slow-marked: the cost is training the categorical model the
+    walker then refuses; the rejection branch itself is a cheap
+    ValueError and the pathforest walk stays tier-1 via matches_walker."""
     rng = np.random.RandomState(5)
     X = rng.randn(2000, 5)
     X[:, 2] = rng.randint(0, 12, 2000)

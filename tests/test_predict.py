@@ -91,7 +91,11 @@ def test_early_stop_small_margin_partial_sums(binary_model):
     assert np.mean(np.sign(es[confident]) == np.sign(base[confident])) > 0.98
 
 
+@pytest.mark.slow
 def test_early_stop_multiclass():
+    """Slow-marked: prediction early-stop stays tier-1 via the binary
+    huge/small-margin tests; this re-proves the same margin rule on the
+    multiclass output layout, which test_multiclass keeps covered."""
     rng = np.random.RandomState(11)
     X = rng.randn(600, 8)
     y = (X[:, 0] > 0.3).astype(int) + (X[:, 1] > 0.3).astype(int)

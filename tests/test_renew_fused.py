@@ -49,12 +49,14 @@ def _train_pair(objective, extra=None, weighted=False, rounds=5, seed=3):
 @pytest.mark.parametrize("objective,extra,weighted", [
     ("regression_l1", None, False),
     # the weighted twins only vary the sample weights of an already-
-    # covered objective (test_weights exercises weighting itself);
-    # tier-1 keeps one variant per objective, the full run keeps all
+    # covered objective (test_weights exercises weighting itself), and
+    # the heavy params only vary alpha; tier-1 keeps the cheapest
+    # variant per mechanism (l1 + quantile a=0.2), the full run keeps
+    # all — mape stays objective-covered via TestObjectives::test_mape
     pytest.param("regression_l1", None, True, marks=pytest.mark.slow),
     ("quantile", {"alpha": 0.2}, False),
-    ("quantile", {"alpha": 0.8}, True),
-    ("mape", None, False),
+    pytest.param("quantile", {"alpha": 0.8}, True, marks=pytest.mark.slow),
+    pytest.param("mape", None, False, marks=pytest.mark.slow),
     pytest.param("mape", None, True, marks=pytest.mark.slow),
 ])
 def test_renew_objective_takes_fused_and_matches_host(objective, extra,

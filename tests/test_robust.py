@@ -249,11 +249,15 @@ class TestResumeBitIdentity:
     def test_serial(self, tmp_path):
         _assert_resume_matches_fresh(tmp_path, {"tpu_fused": False})
 
+    @pytest.mark.slow
     def test_quantized_grad(self, tmp_path):
+        """Slow-marked: resume bit-identity stays tier-1 via
+        test_serial; the quantized variant only swaps the gradient
+        representation the resume path round-trips."""
         _assert_resume_matches_fresh(tmp_path, {"use_quantized_grad": 1})
 
-    # dart resume rides the full run; serial/quantized resume and the
-    # SIGKILL chaos drill keep bit-identity tier-1
+    # dart/quantized resume and the SIGKILL chaos drill ride the full
+    # run; serial resume keeps bit-identity tier-1
     @pytest.mark.slow
     def test_dart(self, tmp_path):
         _assert_resume_matches_fresh(
@@ -336,10 +340,15 @@ _CHILD = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_chaos_sigkill_resume_is_bit_identical(tmp_path):
     """Kill a real training process entering iteration 4 (SIGKILL — no
     atexit, no flush), resume it from the surviving checkpoints, and
-    demand the final model is byte-identical to an uninterrupted run."""
+    demand the final model is byte-identical to an uninterrupted run.
+
+    Slow-marked: resume bit-identity stays tier-1 via
+    TestResumeBitIdentity (serial + quantized); this adds the
+    subprocess SIGKILL delivery on top of the same resume path."""
     d = str(tmp_path / "ck")
     out = str(tmp_path / "model.txt")
     env = dict(os.environ,

@@ -223,11 +223,11 @@ class TestTrainingHang:
         byte-identical to a run that never hung."""
         X, y = _make_data()
         d = str(tmp_path / "ck")
-        # wide margins: a loaded single-core box shows natural ~0.3 s
+        # wide margins: a loaded single-core box shows natural ~0.7 s
         # inter-heartbeat stalls, which must not trip the watchdog during
         # the post-resume replay — only the injected hang may.
-        install_plan("train.iteration:hang=1.5@4")
-        healed = _train(dict(BASE, hang_timeout=0.6, auto_resume=True),
+        install_plan("train.iteration:hang=3.0@4")
+        healed = _train(dict(BASE, hang_timeout=1.2, auto_resume=True),
                         X, y, 6, ckpt_dir=d)
         install_plan(None)
         fresh = _train(BASE, X, y, 6)
